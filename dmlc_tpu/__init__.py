@@ -1,0 +1,41 @@
+"""dmlc_tpu — a TPU-native data/IO framework with the capabilities of dmlc-core.
+
+Re-designed (not ported) from the reference `trivialfis/dmlc-core`:
+
+- ``dmlc_tpu.utils``    — logging/CHECK, Registry, Parameter, serializer, config
+  (reference: include/dmlc/{logging,registry,parameter,serializer,config}.h)
+- ``dmlc_tpu.io``       — Stream/SeekStream, URI-dispatched virtual filesystems,
+  InputSplit sharding, RecordIO codec, threaded prefetch
+  (reference: include/dmlc/{io,recordio,filesystem}.h, src/io/*)
+- ``dmlc_tpu.data``     — CSR RowBlock, libsvm/csv/libfm/parquet parsers,
+  row iterators (reference: include/dmlc/data.h, src/data/*)
+- ``dmlc_tpu.parallel`` — multi-host sharded ingest, device prefetch,
+  job launch (reference: tracker/dmlc_tracker/*)
+- ``dmlc_tpu.ops``      — JAX/TPU ops over CSR batches (SpMV etc.; new —
+  the reference has no device compute, this is the TPU-native seam)
+- ``dmlc_tpu.native``   — C++ hot path (parse/split/prefetch) via ctypes
+
+The hot byte path (sharding, parsing) has two implementations with identical
+semantics: a pure-Python golden (always available, used for parity tests) and a
+C++ engine (used when built). Parity contract: decimal float parsing is
+"nearest double, then cast to float32" on both paths.
+"""
+
+__version__ = "0.1.0"
+
+from dmlc_tpu.utils.logging import DMLCError, check, log_info, log_warning, log_error, log_fatal
+from dmlc_tpu.utils.registry import Registry
+from dmlc_tpu.utils.parameter import Parameter, field, get_env
+from dmlc_tpu.io.stream import Stream, SeekStream, MemoryStream
+from dmlc_tpu.io.tempdir import TemporaryDirectory
+from dmlc_tpu.data.rowblock import RowBlock, RowBlockContainer
+from dmlc_tpu.data.parser import Parser
+from dmlc_tpu.data.row_iter import RowBlockIter
+
+__all__ = [
+    "DMLCError", "check", "log_info", "log_warning", "log_error", "log_fatal",
+    "Registry", "Parameter", "field", "get_env",
+    "Stream", "SeekStream", "MemoryStream", "TemporaryDirectory",
+    "RowBlock", "RowBlockContainer", "Parser", "RowBlockIter",
+    "__version__",
+]

@@ -1,0 +1,18 @@
+"""Data layer: CSR RowBlock, parsers, row iterators.
+
+Reference: include/dmlc/data.h, src/data.cc, src/data/*.
+Importing this package registers the built-in parsers (libsvm/csv/libfm,
+plus parquet when pyarrow is available) — the analogue of the reference's
+DMLC_REGISTRY_LINK_TAG forced linking.
+"""
+
+from dmlc_tpu.data.rowblock import RowBlock, Row, RowBlockContainer
+from dmlc_tpu.data.parser import Parser, DataIter
+from dmlc_tpu.data.row_iter import RowBlockIter
+import dmlc_tpu.data.libsvm_parser  # noqa: F401  (registers "libsvm")
+import dmlc_tpu.data.csv_parser     # noqa: F401  (registers "csv")
+import dmlc_tpu.data.libfm_parser   # noqa: F401  (registers "libfm")
+import dmlc_tpu.data.parquet_parser  # noqa: F401 (registers "parquet" if pyarrow)
+
+__all__ = ["RowBlock", "Row", "RowBlockContainer", "Parser", "DataIter",
+           "RowBlockIter"]

@@ -1,0 +1,73 @@
+"""LibFM text parser: ``label field:idx:val ...`` → CSR with field[].
+
+Reference: src/data/libfm_parser.h — LibFMParser<I>::ParseBlock.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from dmlc_tpu.data.parser import PARSER_REGISTRY, TextParserBase
+from dmlc_tpu.data.rowblock import RowBlockContainer
+from dmlc_tpu.data.strtonum import parse_float32
+from dmlc_tpu.utils.logging import DMLCError
+from dmlc_tpu.utils.parameter import Parameter, field
+
+__all__ = ["LibFMParser", "LibFMParserParam"]
+
+
+class LibFMParserParam(Parameter):
+    indexing_mode = field(0, enum=[-1, 0, 1],
+                          desc="0: as-is; 1: one-based input; -1: auto")
+
+
+class LibFMParser(TextParserBase):
+    def __init__(self, **kwargs):
+        self.param = LibFMParserParam()
+        rest = self.param.update_allow_unknown(kwargs)
+        super().__init__(**rest)
+        self._resolved_mode = (self.param.indexing_mode
+                               if self.param.indexing_mode != -1 else None)
+
+    def parse_block(self, records: List[bytes],
+                    container: RowBlockContainer) -> None:
+        rows = []
+        block_min = None
+        for line in records:
+            toks = line.split()
+            if not toks:
+                continue
+            label = parse_float32(toks[0])
+            n = len(toks) - 1
+            fields = np.empty(n, np.int64)
+            idxs = np.empty(n, np.int64)
+            vals = np.empty(n, np.float32)
+            for j, t in enumerate(toks[1:]):
+                parts = t.split(b":")
+                if len(parts) != 3:
+                    raise DMLCError(f"libfm: bad token {t!r} "
+                                    "(want field:idx:val)")
+                fields[j] = int(parts[0])
+                idxs[j] = int(parts[1])
+                vals[j] = parse_float32(parts[2])
+            if n:
+                m = int(idxs.min())
+                block_min = m if block_min is None else min(block_min, m)
+            rows.append((label, fields, idxs, vals))
+        if self._resolved_mode is None:
+            self._resolved_mode = 0 if (block_min == 0 or block_min is None) else 1
+        shift = self._resolved_mode
+        for label, fields, idxs, vals in rows:
+            if shift:
+                idxs = idxs - shift
+                if len(idxs) and idxs.min() < 0:
+                    raise DMLCError("libfm: index 0 with indexing_mode=1")
+            container.push(label, idxs.astype(self.index_dtype), vals,
+                           fields=fields)
+
+
+@PARSER_REGISTRY.register("libfm", description="label field:idx:val text")
+def _make_libfm(**kwargs):
+    return LibFMParser(**kwargs)

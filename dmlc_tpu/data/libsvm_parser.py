@@ -1,0 +1,89 @@
+"""LibSVM text parser: ``label [qid:N] idx:val idx:val ...`` → CSR.
+
+Reference: src/data/libsvm_parser.h — LibSVMParser<I>::ParseBlock,
+LibSVMParserParam{indexing_mode}.
+
+indexing_mode: 0 = indices used as-is (default), 1 = input is 1-based,
+subtract one; -1 = auto-detect per parser instance from the first parsed
+block (0-based iff a zero index is seen — reference semantics; note
+auto-detection is per-shard, as in the reference).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from dmlc_tpu.data.parser import PARSER_REGISTRY, TextParserBase
+from dmlc_tpu.data.rowblock import RowBlockContainer
+from dmlc_tpu.data.strtonum import parse_float32
+from dmlc_tpu.utils.logging import DMLCError
+from dmlc_tpu.utils.parameter import Parameter, field
+
+__all__ = ["LibSVMParser", "LibSVMParserParam"]
+
+
+class LibSVMParserParam(Parameter):
+    indexing_mode = field(0, enum=[-1, 0, 1],
+                          desc="0: as-is; 1: one-based input; -1: auto-detect")
+
+
+class LibSVMParser(TextParserBase):
+    def __init__(self, **kwargs):
+        self.param = LibSVMParserParam()
+        rest = self.param.update_allow_unknown(kwargs)
+        super().__init__(**rest)
+        self._resolved_mode = (self.param.indexing_mode
+                               if self.param.indexing_mode != -1 else None)
+
+    def parse_block(self, records: List[bytes],
+                    container: RowBlockContainer) -> None:
+        rows = []
+        block_min = None
+        for line in records:
+            toks = line.split()
+            if not toks:
+                continue
+            label = parse_float32(toks[0])
+            qid = -1
+            feats = toks[1:]
+            if feats and feats[0].startswith(b"qid:"):
+                qid = int(feats[0][4:])
+                feats = feats[1:]
+            idxs = np.empty(len(feats), np.int64)
+            vals = np.empty(len(feats), np.float32)
+            for j, t in enumerate(feats):
+                i, sep, v = t.rpartition(b":")
+                if not sep:
+                    raise DMLCError(f"libsvm: bad feature token {t!r}")
+                idxs[j] = int(i)
+                vals[j] = parse_float32(v)
+            if len(idxs):
+                m = int(idxs.min())
+                block_min = m if block_min is None else min(block_min, m)
+            rows.append((label, idxs, vals, qid))
+        if self._resolved_mode is None:
+            # auto-detect: 0-based iff a zero index was observed
+            self._resolved_mode = 0 if (block_min == 0 or block_min is None) else 1
+        shift = self._resolved_mode
+        for label, idxs, vals, qid in rows:
+            if shift:
+                idxs = idxs - shift
+                if len(idxs) and idxs.min() < 0:
+                    raise DMLCError(
+                        "libsvm: index 0 found with indexing_mode=1")
+            container.push(label, idxs.astype(self.index_dtype), vals, qid=qid)
+
+
+@PARSER_REGISTRY.register("libsvm", description="label idx:val sparse text")
+def _make_libsvm(**kwargs):
+    engine = kwargs.get("engine", "auto")
+    if engine in ("auto", "native"):
+        from dmlc_tpu.native import native_available
+        if native_available():
+            from dmlc_tpu.native.bindings import NativeLibSVMParser
+            return NativeLibSVMParser(**kwargs)
+        if engine == "native":
+            raise DMLCError("native engine requested but not built")
+    return LibSVMParser(**kwargs)

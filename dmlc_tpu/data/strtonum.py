@@ -1,0 +1,42 @@
+"""Numeric text parsing — the frozen golden semantics.
+
+Reference: include/dmlc/strtonum.h (ParseFloat/ParseDouble/ParseSignedIndex,
+locale-free isspace/isdigit). The reference's float parse is a hand-rolled
+accumulate-and-scale loop that is NOT exactly IEEE-rounded for long
+mantissas; rather than reproduce that accident, this framework FREEZES the
+parity contract as:
+
+    decimal string --strtod--> nearest float64 --cast--> float32
+
+Both the Python golden (this file: Python ``float`` is exactly strtod) and
+the C++ engine (std::from_chars<double>, correctly rounded, then
+static_cast<float>) implement this contract, so CSR value arrays are
+byte-identical across paths. tests/test_strtonum.py locks it with property
+tests over adversarial decimal strings.
+
+Integer parse: base-10, optional sign, no locale (C++ from_chars<int64>).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["parse_float32", "parse_float64", "parse_index", "F32"]
+
+F32 = np.float32
+
+
+def parse_float64(token: bytes) -> float:
+    """strtod semantics (Python float is correctly-rounded strtod)."""
+    # bytes.__float__ via float(): accepts ascii inf/nan like strtod
+    return float(token)
+
+
+def parse_float32(token: bytes) -> np.float32:
+    """The frozen contract: nearest-double, then cast to float32."""
+    return np.float32(float(token))
+
+
+def parse_index(token: bytes) -> int:
+    """Base-10 integer (reference: ParseSignedIndex)."""
+    return int(token)

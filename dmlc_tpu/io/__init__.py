@@ -1,0 +1,23 @@
+"""IO layer: streams, virtual filesystems, input splitting, RecordIO.
+
+Reference: include/dmlc/{io,recordio,filesystem,input_split_shuffle}.h,
+src/io.cc, src/io/*, src/recordio.cc.
+"""
+
+from dmlc_tpu.io.stream import (
+    Stream, SeekStream, MemoryStream, Serializable, create_stream,
+    create_seek_stream_for_read,
+)
+from dmlc_tpu.io.filesys import FileSystem, FileInfo, URI, LocalFileSystem
+from dmlc_tpu.io.tempdir import TemporaryDirectory
+from dmlc_tpu.io.input_split import InputSplit
+from dmlc_tpu.io.recordio import (
+    RecordIOWriter, RecordIOReader, RecordIOChunkReader, RECORDIO_MAGIC,
+)
+
+__all__ = [
+    "Stream", "SeekStream", "MemoryStream", "Serializable", "create_stream",
+    "create_seek_stream_for_read", "FileSystem", "FileInfo", "URI",
+    "LocalFileSystem", "TemporaryDirectory", "InputSplit",
+    "RecordIOWriter", "RecordIOReader", "RecordIOChunkReader", "RECORDIO_MAGIC",
+]

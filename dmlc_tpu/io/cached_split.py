@@ -1,0 +1,118 @@
+"""Write-through local chunk cache for remote splits.
+
+Reference: src/io/cached_input_split.h — CachedInputSplit (URI suffix
+``#cache.file``): first pass streams from the source while writing chunks
+to a local cache file; later passes replay the cache (pure local reads).
+
+Cache format: sequence of ``u64 length | chunk bytes``; the cache path is
+suffixed with ``.pK-N`` so different (part, num_parts) shards never mix.
+A ``.done`` marker commits the cache (a torn first pass is re-run).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, Optional
+
+from dmlc_tpu.io.input_split import InputSplit
+from dmlc_tpu.utils.logging import check
+
+__all__ = ["CachedInputSplit"]
+
+
+class CachedInputSplit(InputSplit):
+    def __init__(self, base: InputSplit, cache_file: str):
+        self._base = base
+        self._cache_template = cache_file
+        self._configure_paths()
+        self._reader = None
+        self._writer = None
+        self._bytes = 0
+
+    def _configure_paths(self) -> None:
+        part = getattr(self._base, "part_index", 0)
+        npart = getattr(self._base, "num_parts", 1)
+        self._cache_path = f"{self._cache_template}.p{part}-{npart}"
+        self._done_path = self._cache_path + ".done"
+
+    @property
+    def _cached(self) -> bool:
+        return os.path.exists(self._done_path)
+
+    def before_first(self) -> None:
+        self._recbuf = None
+        self._recpos = 0
+        self._bytes = 0
+        if self._writer is not None:
+            # torn pass: discard partial cache
+            self._writer.close()
+            self._writer = None
+            try:
+                os.remove(self._cache_path + ".tmp")
+            except OSError:
+                pass
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+        if not self._cached:
+            self._base.before_first()
+            self._writer = open(self._cache_path + ".tmp", "wb")
+        else:
+            self._reader = open(self._cache_path, "rb")
+
+    def next_chunk(self) -> Optional[bytes]:
+        if self._reader is None and self._writer is None:
+            self.before_first()
+        if self._reader is not None:
+            head = self._reader.read(8)
+            if len(head) < 8:
+                return None
+            (n,) = struct.unpack("<Q", head)
+            chunk = self._reader.read(n)
+            check(len(chunk) == n, "cache file truncated")
+            self._bytes += n
+            return chunk
+        chunk = self._base.next_chunk()
+        if chunk is None:
+            # commit the cache
+            self._writer.close()
+            self._writer = None
+            os.replace(self._cache_path + ".tmp", self._cache_path)
+            open(self._done_path, "wb").close()
+            return None
+        self._writer.write(struct.pack("<Q", len(chunk)))
+        self._writer.write(chunk)
+        self._bytes += len(chunk)
+        return chunk
+
+    def next_record(self) -> Optional[bytes]:
+        while True:
+            buf = getattr(self, "_recbuf", None)
+            pos = getattr(self, "_recpos", 0)
+            if buf is not None and pos < len(buf):
+                self._recpos = pos + 1
+                return buf[pos]
+            chunk = self.next_chunk()
+            if chunk is None:
+                self._recbuf = None
+                return None
+            self._recbuf = list(self.extract_records(chunk))
+            self._recpos = 0
+
+    def extract_records(self, chunk: bytes) -> Iterator[bytes]:
+        return self._base.extract_records(chunk)
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        self._base.reset_partition(part_index, num_parts)
+        self._configure_paths()
+        self._reader = None
+        self._writer = None
+        self.before_first()
+
+    def get_total_size(self) -> int:
+        return self._base.get_total_size()
+
+    @property
+    def bytes_read(self) -> int:
+        return self._bytes

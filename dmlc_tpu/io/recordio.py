@@ -1,0 +1,186 @@
+"""RecordIO framed binary record format.
+
+Reference: include/dmlc/recordio.h + src/recordio.cc —
+RecordIOWriter::WriteRecord (kMagic = 0xced7230a, EncodeLRec(cflag,len) =
+cflag<<29 | len, cflag ∈ {0 whole, 1 start, 2 middle, 3 end}),
+RecordIOReader::NextRecord, RecordIOChunkReader.
+
+Format contract (frozen by round-trip property tests in
+tests/test_recordio.py):
+
+- A record is written as one or more *frames*. Each frame is
+  ``magic(u32 LE) | lrec(u32 LE) | payload | pad-to-4B``, where
+  ``lrec = cflag<<29 | payload_len`` (payload_len < 2^29).
+- Magic-collision escaping: before writing, the payload is scanned at
+  4-byte-aligned positions for the magic u32; each aligned occurrence is
+  *removed* and becomes a frame boundary (the reader re-inserts the magic
+  bytes when stitching frames back together). Hence the byte stream never
+  contains the magic at a 4-byte-aligned position except at frame heads —
+  which is what makes shard realignment by magic-scan sound
+  (reference: src/io/recordio_split.cc SeekRecordBegin).
+- cflag: 0 = whole record in one frame; multi-frame records use
+  1 (start), 2 (middle), 3 (end).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Optional, Tuple, Union
+
+from dmlc_tpu.io.stream import Stream
+from dmlc_tpu.utils.logging import DMLCError, check
+
+__all__ = [
+    "RECORDIO_MAGIC", "RecordIOWriter", "RecordIOReader",
+    "RecordIOChunkReader", "encode_lrec", "decode_flag", "decode_length",
+]
+
+RECORDIO_MAGIC = 0xced7230a
+_MAGIC_BYTES = struct.pack("<I", RECORDIO_MAGIC)
+
+
+def encode_lrec(cflag: int, length: int) -> int:
+    """Reference: RecordIOWriter::EncodeLRec."""
+    check(0 <= cflag < 4 and 0 <= length < (1 << 29),
+          f"bad lrec cflag={cflag} len={length}")
+    return (cflag << 29) | length
+
+
+def decode_flag(rec: int) -> int:
+    return (rec >> 29) & 7
+
+
+def decode_length(rec: int) -> int:
+    return rec & ((1 << 29) - 1)
+
+
+class RecordIOWriter:
+    """Reference: RecordIOWriter (src/recordio.cc)."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+        self.except_counter = 0  # number of magic collisions escaped
+
+    def write_record(self, data: Union[bytes, bytearray, memoryview]) -> None:
+        data = bytes(data)
+        size = len(data)
+        check(size < (1 << 29), "RecordIO: record too large (>= 2^29 bytes)")
+        s = self._stream
+        # scan 4-byte-aligned positions for magic; each occurrence splits
+        # the record into frames with the magic removed
+        lower_align = (size >> 2) << 2
+        dptr = 0
+        i = data.find(_MAGIC_BYTES)
+        while i != -1 and i < lower_align:
+            if i % 4 == 0:
+                lrec = encode_lrec(1 if dptr == 0 else 2, i - dptr)
+                s.write(_MAGIC_BYTES)
+                s.write(struct.pack("<I", lrec))
+                if i != dptr:
+                    s.write(data[dptr:i])
+                dptr = i + 4
+                self.except_counter += 1
+                i = data.find(_MAGIC_BYTES, dptr)
+            else:
+                i = data.find(_MAGIC_BYTES, i + 1)
+        lrec = encode_lrec(3 if dptr != 0 else 0, size - dptr)
+        s.write(_MAGIC_BYTES)
+        s.write(struct.pack("<I", lrec))
+        if size != dptr:
+            s.write(data[dptr:size])
+        pad = (-size) % 4
+        if pad:
+            s.write(b"\x00" * pad)
+
+
+class RecordIOReader:
+    """Reference: RecordIOReader (src/recordio.cc)."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+        self._eos = False
+
+    def next_record(self) -> Optional[bytes]:
+        """Next record payload, or None at end of stream."""
+        if self._eos:
+            return None
+        s = self._stream
+        parts: List[bytes] = []
+        while True:
+            head = s.read(4)
+            if len(head) == 0:
+                self._eos = True
+                check(not parts, "RecordIO: truncated multi-frame record")
+                return None
+            check(len(head) == 4, "RecordIO: truncated magic")
+            check(struct.unpack("<I", head)[0] == RECORDIO_MAGIC,
+                  "RecordIO: invalid magic number")
+            lrec = struct.unpack("<I", s.read_exact(4))[0]
+            cflag, clen = decode_flag(lrec), decode_length(lrec)
+            payload = s.read_exact(clen)
+            pad = (-clen) % 4
+            if pad:
+                s.read_exact(pad)
+            if cflag == 0:
+                check(not parts, "RecordIO: whole-frame inside multi-frame")
+                return payload
+            if cflag == 1:
+                check(not parts, "RecordIO: start-frame inside multi-frame")
+                parts.append(payload)
+            elif cflag == 2:
+                check(bool(parts), "RecordIO: middle-frame without start")
+                parts.append(payload)
+            else:  # end
+                check(bool(parts), "RecordIO: end-frame without start")
+                parts.append(payload)
+                # re-insert the escaped magic between frames
+                return _MAGIC_BYTES.join(parts)
+
+
+class RecordIOChunkReader:
+    """Extract records from an in-memory chunk of whole frames.
+
+    Reference: RecordIOChunkReader(InputSplit::Blob) — used on the
+    parse side where InputSplit hands us chunk buffers aligned to frame
+    boundaries.
+    """
+
+    def __init__(self, chunk: Union[bytes, memoryview]):
+        self._data = memoryview(chunk)
+        self._pos = 0
+
+    def next_record(self) -> Optional[bytes]:
+        d, n = self._data, len(self._data)
+        parts: List[bytes] = []
+        while True:
+            if self._pos >= n:
+                check(not parts, "RecordIO chunk: truncated multi-frame record")
+                return None
+            check(self._pos + 8 <= n, "RecordIO chunk: truncated frame header")
+            magic, lrec = struct.unpack_from("<II", d, self._pos)
+            check(magic == RECORDIO_MAGIC, "RecordIO chunk: invalid magic")
+            cflag, clen = decode_flag(lrec), decode_length(lrec)
+            start = self._pos + 8
+            check(start + clen <= n, "RecordIO chunk: truncated payload")
+            payload = bytes(d[start:start + clen])
+            self._pos = start + clen + ((-clen) % 4)
+            if cflag == 0:
+                check(not parts, "RecordIO chunk: whole-frame inside multi-frame")
+                return payload
+            if cflag == 1:
+                check(not parts, "RecordIO chunk: start inside multi-frame")
+                parts.append(payload)
+            elif cflag == 2:
+                check(bool(parts), "RecordIO chunk: middle without start")
+                parts.append(payload)
+            else:
+                check(bool(parts), "RecordIO chunk: end without start")
+                parts.append(payload)
+                return _MAGIC_BYTES.join(parts)
+
+    def __iter__(self) -> Iterator[bytes]:
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec

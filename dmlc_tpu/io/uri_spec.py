@@ -1,0 +1,40 @@
+"""User-URI decomposition: path + cache hint + kwargs.
+
+Reference: src/io/uri_spec.h — io::URISpec{uri, cache_file, args}.
+
+Convention (same as the reference / XGBoost data URIs):
+``path?k1=v1&k2=v2#cachefile`` — '#' introduces a local cache-file hint
+(reference: CachedInputSplit), '?' introduces parser kwargs such as
+``format=csv``. ';' in the path separates multiple input paths.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+__all__ = ["URISpec"]
+
+
+class URISpec:
+    __slots__ = ("uri", "cache_file", "args")
+
+    def __init__(self, raw: str):
+        path, hash_, cache = raw.partition("#")
+        self.cache_file: str = cache if hash_ else ""
+        path, q, argstr = path.partition("?")
+        self.uri: str = path
+        self.args: Dict[str, str] = {}
+        if q:
+            for kv in argstr.split("&"):
+                if not kv:
+                    continue
+                k, _, v = kv.partition("=")
+                self.args[k] = v
+
+    def paths(self) -> List[str]:
+        """';'-separated multi-path expansion."""
+        return [p for p in self.uri.split(";") if p]
+
+    def __repr__(self) -> str:
+        return (f"URISpec(uri={self.uri!r}, cache_file={self.cache_file!r}, "
+                f"args={self.args!r})")

@@ -1,0 +1,53 @@
+"""Native C++ engine loader.
+
+The hot byte path (InputSplit sharding, text→CSR parse, prefetch) has a
+C++ implementation (native/src/*.cc) built as a shared library and bound
+via ctypes (no pybind11 in this environment). This module loads it lazily;
+when absent, the pure-Python golden engines are used with identical
+semantics.
+
+Build: ``python -m dmlc_tpu.native.build`` (uses g++ -O3 -march=native).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_lib = None
+_tried = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "libdmlc_tpu.so")
+
+
+def native_available() -> bool:
+    global _lib, _tried
+    if not _tried:
+        _tried = True
+        path = _lib_path()
+        if os.path.exists(path):
+            try:
+                from dmlc_tpu.native import bindings
+                _lib = bindings.load(path)
+            except Exception:
+                _lib = None
+    return _lib is not None
+
+
+def get_lib():
+    if not native_available():
+        from dmlc_tpu.utils.logging import DMLCError
+        raise DMLCError("native engine not built; run "
+                        "`python -m dmlc_tpu.native.build`")
+    return _lib
+
+
+def __getattr__(name: str):
+    # NativeLibSVMParser / NativeCSVParser live in bindings; resolve lazily
+    if name in ("NativeLibSVMParser", "NativeCSVParser",
+                "NativeLibFMParser"):
+        from dmlc_tpu.native import bindings
+        return getattr(bindings, name)
+    raise AttributeError(name)

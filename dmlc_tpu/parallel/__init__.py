@@ -1,0 +1,16 @@
+"""Distributed layer: multi-host sharded ingest, device prefetch, launch.
+
+Reference: tracker/dmlc_tracker/* (control plane) — replaced TPU-natively
+by jax.distributed + jax.sharding (SURVEY.md §2.4/§5.8). Data plane:
+each host's InputSplit shard feeds jax.make_array_from_process_local_data.
+"""
+
+from dmlc_tpu.parallel.device_iter import DeviceIter, device_prefetch
+from dmlc_tpu.parallel.sharded import (
+    ShardedRowBlockIter, make_global_batch, pad_to_bucket,
+    stack_device_batches, empty_block, next_pow2_bucket,
+)
+
+__all__ = ["DeviceIter", "device_prefetch", "ShardedRowBlockIter",
+           "make_global_batch", "pad_to_bucket", "stack_device_batches",
+           "empty_block", "next_pow2_bucket"]

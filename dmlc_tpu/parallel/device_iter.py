@@ -1,0 +1,83 @@
+"""Async host→device double-buffered batch iterator.
+
+The TPU-native replacement for the reference's ThreadedIter on the
+host→HBM edge (SURVEY.md §7 step 6): while the model consumes batch t,
+batch t+1 is already in flight to HBM. jax.device_put is async (returns
+immediately with the transfer enqueued), so a lookahead queue of in-flight
+device batches gives transfer/compute overlap without threads.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+import jax
+
+__all__ = ["device_prefetch", "DeviceIter"]
+
+
+def device_prefetch(host_batches: Iterable[Dict[str, Any]], size: int = 2,
+                    sharding=None) -> Iterator[Dict[str, Any]]:
+    """Yield device-resident batches with ``size`` transfers in flight.
+
+    ``sharding`` may be a jax.sharding.Sharding (multi-device placement) or
+    None (default device). Structure of each batch (dict/pytree of numpy
+    arrays) is preserved.
+    """
+    queue: collections.deque = collections.deque()
+
+    def _put(batch):
+        if sharding is None:
+            return jax.tree.map(jax.device_put, batch)
+        return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+    it = iter(host_batches)
+    try:
+        for _ in range(size):
+            queue.append(_put(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(_put(next(it)))
+        except StopIteration:
+            pass
+        yield out
+
+
+class DeviceIter:
+    """DataIter-protocol wrapper around device_prefetch
+    (reference: ThreadedIter's consumer API, device-side)."""
+
+    def __init__(self, host_iter_factory: Callable[[], Iterable],
+                 size: int = 2, sharding=None):
+        self._factory = host_iter_factory
+        self._size = size
+        self._sharding = sharding
+        self._gen: Optional[Iterator] = None
+        self._value = None
+
+    def before_first(self) -> None:
+        self._gen = device_prefetch(self._factory(), self._size,
+                                    self._sharding)
+        self._value = None
+
+    def next(self) -> bool:
+        if self._gen is None:
+            self.before_first()
+        try:
+            self._value = next(self._gen)
+            return True
+        except StopIteration:
+            self._value = None
+            return False
+
+    def value(self):
+        return self._value
+
+    def __iter__(self):
+        self.before_first()
+        while self.next():
+            yield self.value()

@@ -1,0 +1,215 @@
+"""Multi-host sharded ingest: local shards → globally sharded jax.Array.
+
+The TPU-native analogue of the reference's distributed story (SURVEY.md
+§2.4, §5.8): the reference gives each worker a disjoint byte range via
+InputSplit(uri, rank, world) and leaves assembly to the learner; here the
+dataset is sharded at *device* granularity — global device d parses part
+d of num_devices — and each field assembles into ONE global jax.Array of
+shape [num_devices, ...] sharded on the mesh's data axis via
+jax.make_array_from_process_local_data. Collectives then ride ICI/DCN via
+XLA (no sockets, no NCCL translation; the tracker's control-plane job is
+jax.distributed — see dmlc_tpu.parallel.launch).
+
+Layout contract (the SPMD-friendly shape for CSR):
+every device holds its OWN padded CSR block —
+  offset [D, row_bucket+1] int64   (D = global devices, dim 0 sharded)
+  label/weight [D, row_bucket] f32
+  index [D, nnz_bucket] u32/u64, value [D, nnz_bucket] f32
+  num_rows/num_nnz [D] int32       (true sizes under the padding)
+Consumers shard_map over the data axis: each device computes on its block
+with static shapes, then psum/all_gather as needed (dmlc_tpu.ops).
+Padded rows are compute-neutral: weight 0, empty; padded nnz: value 0.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.data.rowblock import RowBlock
+from dmlc_tpu.utils.logging import check, check_eq, check_le
+
+__all__ = ["pad_to_bucket", "stack_device_batches", "make_global_batch",
+           "ShardedRowBlockIter", "next_pow2_bucket", "empty_block"]
+
+
+def next_pow2_bucket(n: int, minimum: int = 8) -> int:
+    """Smallest power of two >= max(n, minimum) — bounds compile count."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+def empty_block(index_dtype=np.uint32) -> RowBlock:
+    """A zero-row block (pads out exhausted shards on skewed data)."""
+    return RowBlock(offset=np.zeros(1, np.int64),
+                    label=np.zeros(0, np.float32),
+                    index=np.zeros(0, index_dtype))
+
+
+def pad_to_bucket(block: RowBlock, row_bucket: int,
+                  nnz_bucket: int) -> Dict[str, np.ndarray]:
+    """CSR RowBlock → fixed-shape numpy dict (padded, compute-neutral).
+
+    Keys: offset[row_bucket+1] int64, label/weight[row_bucket] f32,
+    index[nnz_bucket] (block dtype), value[nnz_bucket] f32,
+    num_rows/num_nnz scalars int32. Padded rows are empty (offset
+    repeats) with weight 0; padded nnz carry index 0, value 0.
+    """
+    n, nnz = block.size, block.nnz
+    check_le(n, row_bucket, "row bucket too small")
+    check_le(nnz, nnz_bucket, "nnz bucket too small")
+    offset = np.full(row_bucket + 1, nnz, np.int64)
+    offset[:n + 1] = block.offset
+    label = np.zeros(row_bucket, np.float32)
+    label[:n] = block.label
+    weight = np.zeros(row_bucket, np.float32)
+    weight[:n] = block.weight if block.weight is not None else 1.0
+    index = np.zeros(nnz_bucket, block.index.dtype)
+    index[:nnz] = block.index
+    value = np.zeros(nnz_bucket, np.float32)
+    if block.value is not None:
+        value[:nnz] = block.value
+    else:
+        value[:nnz] = 1.0
+    out = {"offset": offset, "label": label, "weight": weight,
+           "index": index, "value": value,
+           "num_rows": np.int32(n), "num_nnz": np.int32(nnz)}
+    if block.qid is not None:
+        qid = np.full(row_bucket, -1, np.int64)
+        qid[:n] = block.qid
+        out["qid"] = qid
+    if block.field is not None:
+        field = np.zeros(nnz_bucket, np.int64)
+        field[:nnz] = block.field
+        out["field"] = field
+    return out
+
+
+def stack_device_batches(batches: List[Dict[str, np.ndarray]]
+                         ) -> Dict[str, np.ndarray]:
+    """Per-device padded dicts → one local dict with leading device dim."""
+    check(len(batches) > 0, "no device batches")
+    keys = batches[0].keys()
+    for b in batches[1:]:
+        check_eq(set(b.keys()), set(keys), "inconsistent batch keys")
+    return {k: np.stack([np.asarray(b[k]) for b in batches]) for k in keys}
+
+
+def make_global_batch(local: Dict[str, np.ndarray], mesh: Mesh,
+                      axis: str = "data") -> Dict[str, jax.Array]:
+    """Local stacked batch [local_devices, ...] → global jax.Arrays
+    [global_devices, ...] sharded on the mesh's data axis.
+
+    Every process calls this collectively with same-shaped locals; dim 0
+    is the device-shard dim (this process's local batches), stitched into
+    the global array without any host gather.
+    """
+    out: Dict[str, jax.Array] = {}
+    for k, v in local.items():
+        v = np.asarray(v)
+        check(v.ndim >= 1, f"{k}: batch arrays need a leading shard dim")
+        sharding = NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
+        out[k] = jax.make_array_from_process_local_data(sharding, v)
+    return out
+
+
+class ShardedRowBlockIter:
+    """Device-granular sharded ingest: global device d reads part d.
+
+    This process parses parts [proc*L, (proc+1)*L) where L = local device
+    count, pads each device's block stream to (row_bucket, nnz_bucket),
+    stacks, and assembles global arrays. Skewed shards are padded with
+    empty blocks until every device's stream is exhausted, so all
+    processes always agree on batch count (a collective requirement).
+
+    Reference seam: InputSplit(uri, rank, world) per worker →
+    here num_parts = total devices and assembly is a jax.Array.
+    """
+
+    def __init__(self, uri: str, mesh: Mesh, format: Optional[str] = None,
+                 axis: str = "data", row_bucket: int = 1 << 14,
+                 nnz_bucket: int = 1 << 18, index_dtype=np.uint32,
+                 **parser_kwargs):
+        from dmlc_tpu.data.parser import Parser
+        self.mesh = mesh
+        self.axis = axis
+        self.row_bucket = row_bucket
+        self.nnz_bucket = nnz_bucket
+        self.index_dtype = np.dtype(index_dtype)
+        axis_idx = list(mesh.axis_names).index(axis)
+        total_parts = mesh.devices.shape[axis_idx]
+        local = [d for d in mesh.local_devices]
+        # which data-axis coordinates live on this process
+        mesh_devs = mesh.devices.reshape(mesh.devices.shape)
+        coords = []
+        for c, dev in np.ndenumerate(mesh_devs):
+            if dev.process_index == jax.process_index():
+                coords.append(c[axis_idx])
+        self._my_parts = sorted(set(coords))
+        check(len(self._my_parts) > 0, "process owns no mesh devices")
+        self._parsers = [
+            Parser.create(uri, p, total_parts, format=format,
+                          index_dtype=index_dtype, **parser_kwargs)
+            for p in self._my_parts]
+
+    def _block_streams(self) -> Iterator[List[RowBlock]]:
+        """Lockstep streams: one (possibly empty) block per local part."""
+        from dmlc_tpu.parallel.sharded import empty_block  # self-import ok
+        its = []
+        for p in self._parsers:
+            p.before_first()
+            its.append(self._rechunk(p))
+        done = [False] * len(its)
+        while True:
+            row = []
+            for i, it in enumerate(its):
+                if done[i]:
+                    row.append(empty_block(self.index_dtype))
+                    continue
+                try:
+                    row.append(next(it))
+                except StopIteration:
+                    done[i] = True
+                    row.append(empty_block(self.index_dtype))
+            if self._all_processes_done(all(done)):
+                return
+            yield row
+
+    @staticmethod
+    def _all_processes_done(local_done: bool) -> bool:
+        """Collective agreement on stream end: with skewed shards, some
+        processes exhaust early and must keep yielding empty batches until
+        ALL are done (batch count is a collective contract)."""
+        if jax.process_count() == 1:
+            return local_done
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.array([local_done], dtype=np.bool_))
+        return bool(np.all(flags))
+
+    def _rechunk(self, parser) -> Iterator[RowBlock]:
+        """Clip parser blocks to the (row_bucket, nnz_bucket) budget."""
+        while parser.next():
+            block = parser.value()
+            start = 0
+            while start < block.size:
+                end = min(block.size, start + self.row_bucket)
+                base = int(block.offset[start])
+                while int(block.offset[end]) - base > self.nnz_bucket:
+                    end -= 1
+                check(end > start, "nnz_bucket smaller than one row")
+                yield block.slice(start, end)
+                start = end
+
+    def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
+        for blocks in self._block_streams():
+            local = stack_device_batches(
+                [pad_to_bucket(b, self.row_bucket, self.nnz_bucket)
+                 for b in blocks])
+            yield make_global_batch(local, self.mesh, self.axis)

@@ -1,0 +1,210 @@
+"""Tests for streams, VFS, tempdir, RecordIO (reference: unittest_serializer,
+recordio_test, filesys_test, iostream_test)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io.stream import (
+    MemoryStream, create_stream, create_seek_stream_for_read,
+)
+from dmlc_tpu.io.filesys import FileSystem, URI, FileInfo
+from dmlc_tpu.io.tempdir import TemporaryDirectory
+from dmlc_tpu.io.uri_spec import URISpec
+from dmlc_tpu.io.recordio import (
+    RECORDIO_MAGIC, RecordIOChunkReader, RecordIOReader, RecordIOWriter,
+)
+from dmlc_tpu.utils.logging import DMLCError
+
+MAGIC_BYTES = struct.pack("<I", RECORDIO_MAGIC)
+
+
+class TestURI:
+    def test_plain_path(self):
+        u = URI("/tmp/x.txt")
+        assert u.protocol == "file://" and u.name == "/tmp/x.txt"
+
+    def test_file_scheme(self):
+        u = URI("file:///tmp/x")
+        assert u.name == "/tmp/x"
+
+    def test_s3(self):
+        u = URI("s3://bucket/key/a.txt")
+        assert u.protocol == "s3://" and u.host == "bucket"
+        assert u.name == "/key/a.txt"
+        assert u.str_uri() == "s3://bucket/key/a.txt"
+
+    def test_unknown_scheme_stub_raises_on_use(self):
+        u = URI("s3://bucket/key")
+        fs = FileSystem.get_instance(u)
+        with pytest.raises(DMLCError, match="no backend"):
+            fs.open_for_read(u)
+
+    def test_unregistered_scheme(self):
+        with pytest.raises(DMLCError, match="unknown filesystem"):
+            FileSystem.get_instance(URI("zzz://x/y"))
+        assert FileSystem.get_instance(URI("zzz://x/y"), allow_null=True) is None
+
+
+class TestURISpec:
+    def test_full(self):
+        s = URISpec("data/train.csv?format=csv&label_column=0#cachefile")
+        assert s.uri == "data/train.csv"
+        assert s.args == {"format": "csv", "label_column": "0"}
+        assert s.cache_file == "cachefile"
+
+    def test_multipath(self):
+        s = URISpec("a.txt;b.txt")
+        assert s.paths() == ["a.txt", "b.txt"]
+
+
+class TestMemoryStream:
+    def test_rw_seek(self):
+        s = MemoryStream()
+        s.write(b"hello")
+        s.seek(0)
+        assert s.read(2) == b"he"
+        assert s.tell() == 2
+        s.seek(5)
+        s.write(b" world")
+        assert s.getvalue() == b"hello world"
+
+    def test_overwrite_middle(self):
+        s = MemoryStream(b"abcdef")
+        s.seek(2)
+        s.write(b"XY")
+        assert s.getvalue() == b"abXYef"
+
+    def test_read_at_eof(self):
+        s = MemoryStream(b"ab")
+        assert s.read(10) == b"ab"
+        assert s.read(1) == b""
+
+
+class TestLocalFS:
+    def test_stream_roundtrip(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with create_stream(p, "w") as s:
+            s.write(b"data123")
+        with create_stream(p, "r") as s:
+            assert s.read_all() == b"data123"
+        with create_stream(p, "a") as s:
+            s.write(b"more")
+        with create_seek_stream_for_read(p) as s:
+            s.seek(7)
+            assert s.read(4) == b"more"
+
+    def test_allow_null_missing(self, tmp_path):
+        assert create_stream(str(tmp_path / "nope"), "r",
+                             allow_null=True) is None
+        with pytest.raises(FileNotFoundError):
+            create_stream(str(tmp_path / "nope"), "r")
+
+    def test_list_directory(self, tmp_path):
+        (tmp_path / "a.txt").write_bytes(b"xx")
+        (tmp_path / "b.txt").write_bytes(b"yyy")
+        (tmp_path / "sub").mkdir()
+        u = URI(str(tmp_path))
+        fs = FileSystem.get_instance(u)
+        infos = fs.list_directory(u)
+        names = [os.path.basename(i.path) for i in infos]
+        assert names == ["a.txt", "b.txt", "sub"]
+        assert [i.type for i in infos] == ["file", "file", "directory"]
+        assert fs.get_path_info(u).type == "directory"
+
+    def test_as_file_adapter(self, tmp_path):
+        p = str(tmp_path / "t.txt")
+        with create_stream(p, "w") as s:
+            s.as_file().write(b"line1\nline2\n")
+        with create_stream(p, "r") as s:
+            import io
+            assert io.BufferedReader(s.as_file()).readline() == b"line1\n"
+
+
+class TestTemporaryDirectory:
+    def test_create_delete(self):
+        td = TemporaryDirectory()
+        path = td.path
+        assert os.path.isdir(path)
+        with open(os.path.join(path, "x"), "w") as f:
+            f.write("1")
+        os.makedirs(os.path.join(path, "nested", "deep"))
+        td.close()
+        assert not os.path.exists(path)
+
+    def test_context_manager(self):
+        with TemporaryDirectory() as td:
+            path = td.path
+            assert os.path.isdir(path)
+        assert not os.path.exists(path)
+
+
+class TestRecordIO:
+    def roundtrip(self, records):
+        s = MemoryStream()
+        w = RecordIOWriter(s)
+        for r in records:
+            w.write_record(r)
+        s.seek(0)
+        r = RecordIOReader(s)
+        out = []
+        while True:
+            rec = r.next_record()
+            if rec is None:
+                break
+            out.append(rec)
+        assert out == list(records)
+        # chunk reader over the whole buffer must agree
+        chunk_out = list(RecordIOChunkReader(s.getvalue()))
+        assert chunk_out == list(records)
+        return w
+
+    def test_simple(self):
+        self.roundtrip([b"hello", b"world", b""])
+
+    def test_payload_with_magic_aligned(self):
+        # aligned magic in payload must be escaped (frame split)
+        payload = b"abcd" + MAGIC_BYTES + b"efgh"
+        w = self.roundtrip([payload])
+        assert w.except_counter == 1
+
+    def test_payload_magic_at_start(self):
+        w = self.roundtrip([MAGIC_BYTES + b"tail"])
+        assert w.except_counter == 1
+
+    def test_payload_magic_unaligned_not_escaped(self):
+        payload = b"ab" + MAGIC_BYTES + b"cd"  # magic at offset 2: unaligned
+        w = self.roundtrip([payload])
+        assert w.except_counter == 0
+
+    def test_payload_many_magics(self):
+        payload = MAGIC_BYTES * 5
+        w = self.roundtrip([payload])
+        assert w.except_counter == 5
+
+    def test_adversarial_random(self, rng):
+        records = []
+        for _ in range(50):
+            n = rng.randint(0, 64)
+            raw = rng.bytes(n)
+            # splice magic bytes at random positions
+            if n > 4 and rng.rand() < 0.5:
+                pos = rng.randint(0, n - 4)
+                raw = raw[:pos] + MAGIC_BYTES + raw[pos + 4:]
+            records.append(raw)
+        self.roundtrip(records)
+
+    def test_padding_alignment(self):
+        s = MemoryStream()
+        w = RecordIOWriter(s)
+        w.write_record(b"abc")  # 3 bytes -> padded to 4
+        assert len(s.getvalue()) % 4 == 0
+        w.write_record(b"defgh")
+        assert len(s.getvalue()) % 4 == 0
+
+    def test_bad_magic_raises(self):
+        s = MemoryStream(b"\x00" * 16)
+        with pytest.raises(DMLCError, match="magic"):
+            RecordIOReader(s).next_record()

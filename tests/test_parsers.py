@@ -1,0 +1,263 @@
+"""Parser correctness (reference: unittest_parser, libsvm_parser_test) +
+RowBlock semantics + row iterators."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.data.parser import Parser
+from dmlc_tpu.data.rowblock import RowBlock, RowBlockContainer
+from dmlc_tpu.data.row_iter import RowBlockIter
+from dmlc_tpu.io.stream import MemoryStream
+from dmlc_tpu.utils.logging import DMLCError
+
+A1A_SAMPLE = b"""-1 3:1 11:1 14:1 19:1 39:1 42:1 55:1 64:1 67:1 73:1 75:1 76:1 80:1 83:1
+-1 3:1 6:1 17:1 27:1 35:1 40:1 57:1 63:1 69:1 73:1 74:1 76:1 81:1 103:1
++1 4:1 6:1 15:1 21:1 35:1 40:1 57:1 63:1 67:1 73:1 74:1 76:1 80:1 83:1
+-1 5:1 17:1 22:1 36:1 40:1 51:1 61:1 67:1 72:1 74:1 76:1 80:1 95:1
+"""
+
+
+def drain(parser):
+    blocks = []
+    parser.before_first()
+    while parser.next():
+        blocks.append(parser.value())
+    return blocks
+
+
+def concat_blocks(blocks):
+    c = RowBlockContainer(blocks[0].index.dtype if blocks else np.uint32)
+    for b in blocks:
+        c.push_block(b)
+    return c.get_block()
+
+
+class TestLibSVM:
+    def test_basic(self, tmpfile):
+        path = tmpfile("a1a.libsvm", A1A_SAMPLE)
+        parser = Parser.create(path, 0, 1, format="libsvm", prefetch=False)
+        block = concat_blocks(drain(parser))
+        assert block.size == 4
+        np.testing.assert_array_equal(block.label, [-1, -1, 1, -1])
+        assert block.offset[1] - block.offset[0] == 14
+        assert block.index.dtype == np.uint32
+        row0 = block[0]
+        assert list(row0.index[:3]) == [3, 11, 14]
+        np.testing.assert_array_equal(row0.value, np.ones(14, np.float32))
+
+    def test_qid(self, tmpfile):
+        content = b"1 qid:7 1:0.5 2:0.25\n0 qid:9 3:1.5\n"
+        path = tmpfile("q.libsvm", content)
+        parser = Parser.create(path, 0, 1, format="libsvm", prefetch=False)
+        block = concat_blocks(drain(parser))
+        np.testing.assert_array_equal(block.qid, [7, 9])
+        np.testing.assert_allclose(block.value, [0.5, 0.25, 1.5])
+
+    def test_float_values_parity(self, tmpfile):
+        vals = [b"1.5", b"-2.75", b"1e-3", b"3.14159265358979",
+                b"1.0000000000000002", b"2.2250738585072014e-308",
+                b"9007199254740993", b".5", b"5.", b"1e20"]
+        content = b"1 " + b" ".join(
+            b"%d:%s" % (i + 1, v) for i, v in enumerate(vals)) + b"\n"
+        path = tmpfile("f.libsvm", content)
+        parser = Parser.create(path, 0, 1, format="libsvm", prefetch=False)
+        block = concat_blocks(drain(parser))
+        expect = np.array([np.float32(float(v)) for v in vals], np.float32)
+        np.testing.assert_array_equal(block.value, expect)
+
+    def test_indexing_mode_one_based(self, tmpfile):
+        path = tmpfile("one.libsvm", b"1 1:2.0 5:3.0\n")
+        parser = Parser.create(path, 0, 1, format="libsvm",
+                               indexing_mode=1, prefetch=False)
+        block = concat_blocks(drain(parser))
+        np.testing.assert_array_equal(block.index, [0, 4])
+
+    def test_indexing_mode_auto(self, tmpfile):
+        path = tmpfile("auto.libsvm", b"1 1:2.0\n0 3:1.0\n")
+        parser = Parser.create(path, 0, 1, format="libsvm",
+                               indexing_mode=-1, prefetch=False)
+        block = concat_blocks(drain(parser))
+        np.testing.assert_array_equal(block.index, [0, 2])  # detected 1-based
+
+    def test_uri_format_arg(self, tmpfile):
+        path = tmpfile("u.libsvm", b"1 1:1\n")
+        parser = Parser.create(path + "?format=libsvm", prefetch=False)
+        block = concat_blocks(drain(parser))
+        assert block.size == 1
+
+    def test_bad_token_raises(self, tmpfile):
+        path = tmpfile("bad.libsvm", b"1 nonsense\n")
+        parser = Parser.create(path, 0, 1, format="libsvm", prefetch=False)
+        with pytest.raises(DMLCError):
+            drain(parser)
+
+    def test_sharded_parse_consistent(self, tmpfile, rng):
+        lines = []
+        for i in range(500):
+            nnz = rng.randint(1, 10)
+            idxs = np.sort(rng.choice(1000, nnz, replace=False))
+            feats = " ".join(f"{j}:{rng.rand():.6f}" for j in idxs)
+            lines.append(f"{rng.randint(0, 2)} {feats}".encode())
+        path = tmpfile("s.libsvm", b"\n".join(lines) + b"\n")
+        whole = concat_blocks(drain(
+            Parser.create(path, 0, 1, format="libsvm", prefetch=False)))
+        sharded = concat_blocks(sum(
+            (drain(Parser.create(path, k, 4, format="libsvm",
+                                 prefetch=False)) for k in range(4)), []))
+        assert whole.content_hash() == sharded.content_hash()
+
+
+class TestCSV:
+    def test_basic_with_label(self, tmpfile):
+        content = b"1.0,2.0,3.0\n0.0,5.0,6.5\n"
+        path = tmpfile("d.csv", content)
+        parser = Parser.create(path, 0, 1, format="csv", label_column=0,
+                               prefetch=False)
+        block = concat_blocks(drain(parser))
+        assert block.size == 2
+        np.testing.assert_array_equal(block.label, [1.0, 0.0])
+        np.testing.assert_array_equal(block.index, [0, 1, 0, 1])
+        np.testing.assert_allclose(block.value, [2.0, 3.0, 5.0, 6.5])
+
+    def test_no_label(self, tmpfile):
+        path = tmpfile("n.csv", b"1,2\n3,4\n")
+        parser = Parser.create(path, 0, 1, format="csv", prefetch=False)
+        block = concat_blocks(drain(parser))
+        np.testing.assert_array_equal(block.label, [0.0, 0.0])
+        np.testing.assert_allclose(block.value, [1, 2, 3, 4])
+
+    def test_weight_column(self, tmpfile):
+        path = tmpfile("w.csv", b"1,0.5,9\n0,2.0,8\n")
+        parser = Parser.create(path, 0, 1, format="csv", label_column=0,
+                               weight_column=1, prefetch=False)
+        block = concat_blocks(drain(parser))
+        np.testing.assert_allclose(block.weight, [0.5, 2.0])
+        np.testing.assert_allclose(block.value, [9, 8])
+
+    def test_tab_delimiter(self, tmpfile):
+        path = tmpfile("t.tsv", b"1\t2\n3\t4\n")
+        parser = Parser.create(path, 0, 1, format="csv", delimiter="\t",
+                               prefetch=False)
+        block = concat_blocks(drain(parser))
+        np.testing.assert_allclose(block.value, [1, 2, 3, 4])
+
+    def test_ragged_raises(self, tmpfile):
+        path = tmpfile("r.csv", b"1,2\n3\n")
+        parser = Parser.create(path, 0, 1, format="csv", prefetch=False)
+        with pytest.raises(DMLCError, match="column"):
+            drain(parser)
+
+
+class TestLibFM:
+    def test_basic(self, tmpfile):
+        content = b"1 0:3:1.5 2:7:0.5\n-1 1:4:2.0\n"
+        path = tmpfile("x.libfm", content)
+        parser = Parser.create(path, 0, 1, format="libfm", prefetch=False)
+        block = concat_blocks(drain(parser))
+        np.testing.assert_array_equal(block.label, [1, -1])
+        np.testing.assert_array_equal(block.field, [0, 2, 1])
+        np.testing.assert_array_equal(block.index, [3, 7, 4])
+        np.testing.assert_allclose(block.value, [1.5, 0.5, 2.0])
+
+
+class TestRowBlock:
+    def test_slice(self, tmpfile):
+        path = tmpfile("a.libsvm", A1A_SAMPLE)
+        block = concat_blocks(drain(
+            Parser.create(path, 0, 1, format="libsvm", prefetch=False)))
+        sl = block.slice(1, 3)
+        assert sl.size == 2
+        np.testing.assert_array_equal(sl.label, block.label[1:3])
+        np.testing.assert_array_equal(sl[0].index, block[1].index)
+
+    def test_page_save_load(self, rng):
+        c = RowBlockContainer(np.uint32)
+        for i in range(20):
+            nnz = rng.randint(0, 8)
+            c.push(float(i), rng.choice(100, nnz, replace=False),
+                   rng.rand(nnz).astype(np.float32),
+                   weight=float(rng.rand()), qid=i % 3)
+        block = c.get_block()
+        s = MemoryStream()
+        RowBlockContainer.save_block(block, s)
+        RowBlockContainer.save_block(block, s)  # two pages
+        s.seek(0)
+        p1 = RowBlockContainer.load_block(s)
+        p2 = RowBlockContainer.load_block(s)
+        p3 = RowBlockContainer.load_block(s)
+        assert p3 is None
+        assert p1.content_hash() == block.content_hash()
+        assert p2.content_hash() == block.content_hash()
+
+    def test_sdot(self):
+        c = RowBlockContainer(np.uint32)
+        c.push(1.0, [0, 2], [2.0, 3.0])
+        block = c.get_block()
+        w = np.array([1.0, 10.0, 100.0], np.float32)
+        assert block[0].sdot(w) == pytest.approx(302.0)
+
+    def test_memory_cost(self):
+        c = RowBlockContainer(np.uint32)
+        c.push(1.0, [0], [1.0])
+        assert c.get_block().memory_cost_bytes() > 0
+
+
+class TestRowBlockIter:
+    def test_basic_iter(self, tmpfile):
+        path = tmpfile("a.libsvm", A1A_SAMPLE)
+        it = RowBlockIter.create(path, 0, 1, format="libsvm", prefetch=False)
+        blocks = list(it)
+        assert len(blocks) == 1
+        assert blocks[0].size == 4
+        assert it.num_col() == 104
+        assert list(it)[0].size == 4  # replay
+
+    def test_disk_cache_iter(self, tmp_path, rng):
+        lines = []
+        for i in range(200):
+            lines.append(f"{i % 2} {rng.randint(1, 50)}:{rng.rand():.4f}"
+                         .encode())
+        data = tmp_path / "big.libsvm"
+        data.write_bytes(b"\n".join(lines) + b"\n")
+        cache = tmp_path / "pages.cache"
+        uri = f"{data}#{cache}"
+        it = RowBlockIter.create(uri, 0, 1, format="libsvm", prefetch=False)
+        total1 = sum(b.size for b in it)
+        assert total1 == 200
+        assert os.path.exists(str(cache) + ".pages.p0-1")  # shard-namespaced
+        # fresh object replays from cache without the source
+        data.unlink()
+        it2 = RowBlockIter.create(uri, 0, 1, format="libsvm", prefetch=False)
+        total2 = sum(b.size for b in it2)
+        assert total2 == 200
+
+
+class TestDiskIterShardIsolation:
+    def test_parts_do_not_share_cache(self, tmp_path, rng):
+        lines = [f"{i} {i + 1}:1.0".encode() for i in range(100)]
+        data = tmp_path / "s.libsvm"
+        data.write_bytes(b"\n".join(lines) + b"\n")
+        uri = f"{data}#{tmp_path / 'shared.cache'}"
+        it0 = RowBlockIter.create(uri, 0, 2, format="libsvm", prefetch=False)
+        it1 = RowBlockIter.create(uri, 1, 2, format="libsvm", prefetch=False)
+        lab0 = np.concatenate([b.label for b in it0])
+        lab1 = np.concatenate([b.label for b in it1])
+        assert set(lab0).isdisjoint(set(lab1))
+        assert len(lab0) + len(lab1) == 100
+
+    def test_rows_per_page_respected(self, tmp_path):
+        lines = [f"{i} 1:1.0".encode() for i in range(100)]
+        data = tmp_path / "p.libsvm"
+        data.write_bytes(b"\n".join(lines) + b"\n")
+        uri = f"{data}#{tmp_path / 'pg.cache'}"
+        from dmlc_tpu.data.row_iter import DiskRowIter
+        from dmlc_tpu.data.parser import Parser
+        it = DiskRowIter(
+            lambda: Parser.create(str(data), 0, 1, format="libsvm",
+                                  prefetch=False),
+            str(tmp_path / "pg.cache"), rows_per_page=16)
+        sizes = [b.size for b in it]
+        assert sum(sizes) == 100
+        assert all(s == 16 for s in sizes[:-1])

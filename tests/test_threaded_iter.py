@@ -1,0 +1,192 @@
+"""ThreadedIter semantics (reference: unittest_threaditer,
+unittest_threaditer_exc_handling — producer exception rethrow in Next,
+BeforeFirst restart, clean shutdown)."""
+
+import threading
+import time
+
+import pytest
+
+from dmlc_tpu.data.threaded_iter import ThreadedIter
+from dmlc_tpu.utils.concurrency import (
+    ConcurrentBlockingQueue, PriorityBlockingQueue,
+)
+
+
+def make_counter_iter(n, capacity=4):
+    state = {"i": 0}
+
+    def next_fn():
+        if state["i"] >= n:
+            return None
+        state["i"] += 1
+        return state["i"]
+
+    def before_first():
+        state["i"] = 0
+
+    it = ThreadedIter(max_capacity=capacity)
+    it.init(next_fn, before_first)
+    return it
+
+
+class TestThreadedIter:
+    def test_drains_in_order(self):
+        it = make_counter_iter(100)
+        try:
+            assert list(it) == list(range(1, 101))
+        finally:
+            it.destroy()
+
+    def test_end_is_sticky(self):
+        it = make_counter_iter(3)
+        try:
+            assert list(it) == [1, 2, 3]
+            assert it.next() is None
+            assert it.next() is None
+        finally:
+            it.destroy()
+
+    def test_before_first_restarts(self):
+        it = make_counter_iter(10)
+        try:
+            assert list(it) == list(range(1, 11))
+            it.before_first()
+            assert list(it) == list(range(1, 11))
+        finally:
+            it.destroy()
+
+    def test_before_first_mid_stream(self):
+        it = make_counter_iter(1000)
+        try:
+            got = [it.next() for _ in range(5)]
+            assert got == [1, 2, 3, 4, 5]
+            it.before_first()
+            assert it.next() == 1
+        finally:
+            it.destroy()
+
+    def test_producer_exception_rethrown(self):
+        calls = {"n": 0}
+
+        def next_fn():
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise ValueError("producer-died")
+            return calls["n"]
+
+        it = ThreadedIter(max_capacity=2)
+        it.init(next_fn)
+        try:
+            assert it.next() == 1
+            assert it.next() == 2
+            with pytest.raises(ValueError, match="producer-died"):
+                while True:
+                    if it.next() is None:
+                        break
+        finally:
+            it.destroy()
+
+    def test_exception_then_before_first_recovers(self):
+        state = {"fail": True, "i": 0}
+
+        def next_fn():
+            if state["fail"]:
+                raise RuntimeError("first-pass-fails")
+            if state["i"] >= 3:
+                return None
+            state["i"] += 1
+            return state["i"]
+
+        def before_first():
+            state["fail"] = False
+            state["i"] = 0
+
+        it = ThreadedIter(max_capacity=2)
+        it.init(next_fn, before_first)
+        try:
+            with pytest.raises(RuntimeError, match="first-pass-fails"):
+                it.next()
+            it.before_first()
+            assert list(it.__iter__()) == [1, 2, 3] or [
+                it.next(), it.next(), it.next()] == [1, 2, 3]
+        finally:
+            it.destroy()
+
+    def test_bounded_capacity(self):
+        produced = []
+
+        def next_fn():
+            produced.append(1)
+            time.sleep(0.001)
+            return len(produced)
+
+        it = ThreadedIter(max_capacity=3)
+        it.init(next_fn)
+        try:
+            time.sleep(0.3)
+            # producer must stall at capacity (3 queued + 1 in flight)
+            assert len(produced) <= 5
+            assert it.next() == 1
+        finally:
+            it.destroy()
+
+    def test_destroy_while_blocked_producer(self):
+        it = ThreadedIter(max_capacity=1)
+        it.init(lambda: 42)  # infinite producer
+        assert it.next() == 42
+        it.destroy()  # must not hang
+
+    def test_destroy_idempotent(self):
+        it = make_counter_iter(5)
+        it.destroy()
+        it.destroy()
+
+
+class TestConcurrentBlockingQueue:
+    def test_push_pop_order(self):
+        q = ConcurrentBlockingQueue(max_size=10)
+        for i in range(5):
+            q.push(i)
+        assert [q.pop() for _ in range(5)] == [0, 1, 2, 3, 4]
+        assert q.size() == 0
+
+    def test_kill_unblocks_consumer(self):
+        q = ConcurrentBlockingQueue()
+        results = []
+
+        def consumer():
+            results.append(q.pop())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        q.signal_for_kill()
+        t.join(timeout=2)
+        assert not t.is_alive()
+        assert results == [None]
+
+    def test_kill_unblocks_producer(self):
+        q = ConcurrentBlockingQueue(max_size=1)
+        q.push(1)
+        done = []
+
+        def producer():
+            done.append(q.push(2))
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.05)
+        q.signal_for_kill()
+        t.join(timeout=2)
+        assert not t.is_alive()
+        assert done == [False]
+
+    def test_priority(self):
+        q = PriorityBlockingQueue()
+        q.push((1, "low"))
+        q.push((9, "high"))
+        q.push((5, "mid"))
+        assert q.pop() == (9, "high")
+        assert q.pop() == (5, "mid")
+        assert q.pop() == (1, "low")

@@ -1,0 +1,213 @@
+"""Tests for the utils layer (reference: unittest_param/json/config/env/
+logging/serializer)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.utils.logging import (
+    DMLCError, check, check_eq, check_lt, check_notnone, log_fatal,
+    set_log_sink,
+)
+from dmlc_tpu.utils.registry import Registry
+from dmlc_tpu.utils.parameter import Parameter, ParamError, field, get_env
+from dmlc_tpu.utils.config import Config
+from dmlc_tpu.utils import serializer as ser
+from dmlc_tpu.io.stream import MemoryStream
+
+
+class TestLogging:
+    def test_check_pass(self):
+        check(True)
+        check_eq(1, 1)
+        check_lt(1, 2)
+        assert check_notnone("x") == "x"
+
+    def test_check_fail_messages(self):
+        with pytest.raises(DMLCError, match="=="):
+            check_eq(1, 2, "context")
+        with pytest.raises(DMLCError, match="context"):
+            check_eq(1, 2, "context")
+        with pytest.raises(DMLCError):
+            check_notnone(None)
+
+    def test_fatal_raises(self):
+        with pytest.raises(DMLCError, match="boom"):
+            log_fatal("boom")
+
+    def test_custom_sink(self):
+        got = []
+        set_log_sink(lambda lvl, msg: got.append((lvl, msg)))
+        try:
+            with pytest.raises(DMLCError):
+                log_fatal("sunk")
+        finally:
+            set_log_sink(None)
+        assert got == [("FATAL", "sunk")]
+
+
+class TestRegistry:
+    def test_register_find(self):
+        reg = Registry.get("TestReg1")
+
+        @reg.register("alpha", description="first")
+        def make_alpha():
+            return "A"
+
+        assert reg.find("alpha").body() == "A"
+        assert reg.find("missing") is None
+        assert "alpha" in reg.list_all_names()
+
+    def test_duplicate_raises(self):
+        reg = Registry.get("TestReg2")
+        reg.register("x", body=lambda: 1)
+        with pytest.raises(DMLCError, match="already registered"):
+            reg.register("x", body=lambda: 2)
+
+    def test_lookup_error_lists_names(self):
+        reg = Registry.get("TestReg3")
+        reg.register("only", body=lambda: 1)
+        with pytest.raises(DMLCError, match="only"):
+            reg.lookup("nope")
+
+    def test_singleton(self):
+        assert Registry.get("TestReg4") is Registry.get("TestReg4")
+
+
+class MyParam(Parameter):
+    num_hidden = field(100, lower=1, upper=10000, desc="hidden units")
+    learning_rate = field(0.01, lower=0.0)
+    act = field("relu", enum=["relu", "tanh", "sigmoid"])
+    use_bias = field(True)
+    name = field(dtype=str)  # required
+    seed = field(None, dtype=int, optional=True)
+
+
+class TestParameter:
+    def test_defaults_and_kwargs_strings(self):
+        p = MyParam(name="m", num_hidden="200", learning_rate="0.1",
+                    use_bias="false")
+        assert p.num_hidden == 200 and isinstance(p.num_hidden, int)
+        assert p.learning_rate == 0.1
+        assert p.use_bias is False
+        assert p.act == "relu"
+
+    def test_required_missing(self):
+        with pytest.raises(ParamError, match="name"):
+            MyParam(num_hidden=5)
+
+    def test_range_enum_violations(self):
+        with pytest.raises(ParamError, match="lower bound"):
+            MyParam(name="m", num_hidden=0)
+        with pytest.raises(ParamError, match="upper bound"):
+            MyParam(name="m", num_hidden=20000)
+        with pytest.raises(ParamError, match="allowed set"):
+            MyParam(name="m", act="gelu")
+
+    def test_unknown_key(self):
+        with pytest.raises(ParamError, match="unknown"):
+            MyParam(name="m", bogus=1)
+        p = MyParam()
+        rest = p.init_allow_unknown({"name": "m", "bogus": 1})
+        assert rest == {"bogus": 1}
+
+    def test_optional_none_spelling(self):
+        p = MyParam(name="m", seed="None")
+        assert p.seed is None
+        p2 = MyParam(name="m", seed="7")
+        assert p2.seed == 7
+        assert p2.get_dict()["seed"] == "7"
+        assert p.get_dict()["seed"] == "None"
+
+    def test_doc_generation(self):
+        doc = MyParam.__DOC__
+        assert "num_hidden" in doc and "hidden units" in doc
+        assert "required" in doc  # name has no default
+
+    def test_setattr_validates(self):
+        p = MyParam(name="m")
+        with pytest.raises(ParamError):
+            p.num_hidden = -1
+
+    def test_update_dict_consumes(self):
+        p = MyParam(name="m")
+        kw = {"num_hidden": "5", "other": "x"}
+        p.update_dict(kw)
+        assert kw == {"other": "x"}
+        assert p.num_hidden == 5
+
+
+class TestGetEnv:
+    def test_get_env(self, monkeypatch):
+        monkeypatch.setenv("DMLC_TPU_TEST_X", "42")
+        assert get_env("DMLC_TPU_TEST_X", int) == 42
+        assert get_env("DMLC_TPU_TEST_MISSING", int, 7) == 7
+        with pytest.raises(ParamError):
+            get_env("DMLC_TPU_TEST_MISSING2", int)
+
+
+class TestConfig:
+    def test_parse_basic(self):
+        cfg = Config("a = 1\nb = hello # comment\n# full comment\nc=3")
+        assert cfg.get_param("a") == "1"
+        assert cfg.get_param("b") == "hello"
+        assert cfg.get_param("c") == "3"
+
+    def test_multi_value(self):
+        cfg = Config("k = 1\nk = 2")
+        assert cfg.get_all("k") == ["1", "2"]
+        assert cfg.get_param("k") == "2"
+        assert list(cfg) == [("k", "1"), ("k", "2")]
+
+    def test_quoted_values(self):
+        cfg = Config('msg = "hello # world \\"quoted\\""')
+        assert cfg.get_param("msg") == 'hello # world "quoted"'
+
+    def test_proto_roundtrip(self):
+        cfg = Config('a = 1\nmsg = "x y"')
+        cfg2 = Config(cfg.proto_string())
+        assert list(cfg) == list(cfg2)
+
+    def test_bad_line(self):
+        with pytest.raises(DMLCError):
+            Config("nonsense line")
+
+
+class TestSerializer:
+    def test_scalars_roundtrip(self):
+        s = MemoryStream()
+        ser.write_u32(s, 7)
+        ser.write_i64(s, -5)
+        ser.write_f32(s, 1.5)
+        ser.write_str(s, "héllo")
+        s.seek(0)
+        assert ser.read_u32(s) == 7
+        assert ser.read_i64(s) == -5
+        assert ser.read_f32(s) == 1.5
+        assert ser.read_str(s) == "héllo"
+
+    def test_ndarray_roundtrip(self, rng):
+        a = rng.randn(3, 4).astype(np.float32)
+        s = MemoryStream()
+        ser.write_ndarray(s, a)
+        s.seek(0)
+        b = ser.read_ndarray(s)
+        np.testing.assert_array_equal(a, b)
+        assert b.dtype == np.float32
+
+    def test_tagged_tree_roundtrip(self, rng):
+        obj = {"a": [1, 2.5, "x", None, True], "b": (b"bytes",),
+               "arr": rng.randint(0, 100, 10).astype(np.uint32)}
+        s = MemoryStream()
+        ser.serialize(obj, s)
+        s.seek(0)
+        out = ser.deserialize(s)
+        assert out["a"] == obj["a"]
+        assert out["b"] == obj["b"]
+        np.testing.assert_array_equal(out["arr"], obj["arr"])
+
+    def test_eof_raises(self):
+        s = MemoryStream(b"\x01\x02")
+        with pytest.raises(DMLCError, match="EOF"):
+            s.read_exact(5)
