@@ -27,14 +27,20 @@ F32 = np.float32
 
 
 def parse_float64(token: bytes) -> float:
-    """strtod semantics (Python float is correctly-rounded strtod)."""
-    # bytes.__float__ via float(): accepts ascii inf/nan like strtod
+    """strtod semantics (Python float is correctly-rounded strtod).
+
+    Python's float() additionally tolerates digit-group underscores
+    ("1_0" == 10.0) which strtod/from_chars reject; the contract is
+    strtod, so underscores are rejected here for engine parity.
+    """
+    if (b"_" if isinstance(token, (bytes, bytearray)) else "_") in token:
+        raise ValueError(f"invalid float literal {token!r}")
     return float(token)
 
 
 def parse_float32(token: bytes) -> np.float32:
     """The frozen contract: nearest-double, then cast to float32."""
-    return np.float32(float(token))
+    return np.float32(parse_float64(token))
 
 
 def parse_index(token: bytes) -> int:

@@ -1,0 +1,13 @@
+"""Flagship downstream consumers of the data stack.
+
+The reference ships no models (dmlc-core sits UNDER XGBoost/MXNet); these
+exist to close the TPU loop — prove that HBM-resident CSR batches train a
+real learner end-to-end under jit/shard_map. SparseLinearModel is the
+flagship: the logistic-regression core of the linear XGBoost booster
+family, consuming exactly the sharded batch layout dmlc_tpu.parallel
+produces.
+"""
+
+from dmlc_tpu.models.linear import SparseLinearModel
+
+__all__ = ["SparseLinearModel"]

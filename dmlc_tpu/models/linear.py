@@ -1,0 +1,129 @@
+"""Sparse linear learner (logistic regression) over CSR batches.
+
+No reference counterpart (dmlc-core has no models); this is the canonical
+TPU consumer of the framework's data layout:
+
+- single-chip: flat padded CSR (parallel.pad_to_bucket) + segment-sum SpMV
+- multi-chip: global [D, ...] batches (parallel.make_global_batch) under
+  shard_map over the mesh's data axis; gradients of replicated params are
+  psum-reduced by construction. Parallelism is DATA parallelism — the only
+  axis the reference's world has (SURVEY.md §2.4: no TP/PP/SP/EP exists
+  to mirror; data sharding IS dmlc-core's distributed model).
+
+Padded rows carry weight 0, so they are loss- and gradient-neutral.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.ops.csr import segment_spmv
+
+__all__ = ["SparseLinearModel"]
+
+
+class SparseLinearModel:
+    """Logistic regression on sparse CSR batches.
+
+    Labels are mapped to {0, 1} via (label > 0) — accepts the ±1
+    convention of libsvm files.
+    """
+
+    def __init__(self, num_features: int, l2: float = 0.0,
+                 learning_rate: float = 0.1):
+        self.num_features = num_features
+        self.l2 = l2
+        self.learning_rate = learning_rate
+
+    def init_params(self, seed: int = 0) -> Dict[str, jnp.ndarray]:
+        del seed  # linear model: zero init is canonical
+        return {"w": jnp.zeros((self.num_features,), jnp.float32),
+                "b": jnp.zeros((), jnp.float32)}
+
+    # -- single-chip path (flat padded batch)
+
+    def forward(self, params: Dict[str, Any],
+                batch: Dict[str, Any]) -> jnp.ndarray:
+        """Margins for one flat padded CSR batch."""
+        num_rows = batch["label"].shape[0]
+        margins = segment_spmv(batch["offset"], batch["index"],
+                               batch["value"], params["w"],
+                               num_rows=num_rows)
+        return margins + params["b"]
+
+    def loss(self, params: Dict[str, Any],
+             batch: Dict[str, Any]) -> jnp.ndarray:
+        """Weighted BCE over real rows (padded rows have weight 0)."""
+        margins = self.forward(params, batch)
+        y = (batch["label"] > 0).astype(jnp.float32)
+        # numerically stable BCE on logits
+        per_row = jnp.maximum(margins, 0) - margins * y + jnp.log1p(
+            jnp.exp(-jnp.abs(margins)))
+        w = batch["weight"]
+        loss = jnp.sum(per_row * w) / jnp.maximum(jnp.sum(w), 1.0)
+        if self.l2:
+            loss = loss + self.l2 * jnp.sum(params["w"] ** 2)
+        return loss
+
+    @partial(jax.jit, static_argnums=0)
+    def train_step(self, params, batch):
+        loss, grads = jax.value_and_grad(self.loss)(params, batch)
+        new_params = jax.tree.map(
+            lambda p, g: p - self.learning_rate * g, params, grads)
+        return new_params, loss
+
+    # -- multi-chip path (global [D, ...] batches, shard_map over 'data')
+
+    def global_loss_fn(self, mesh: Mesh, axis: str = "data"):
+        """Returns loss(params, batch) over a global sharded batch."""
+        def _block_loss(w, b, offset, index, value, label, weight):
+            # inside shard_map: leading dim is this device's single block
+            row_bucket = label.shape[1]
+            margins = segment_spmv(offset[0], index[0], value[0], w,
+                                   num_rows=row_bucket) + b
+            y = (label[0] > 0).astype(jnp.float32)
+            per_row = (jnp.maximum(margins, 0) - margins * y +
+                       jnp.log1p(jnp.exp(-jnp.abs(margins))))
+            lsum = jax.lax.psum(jnp.sum(per_row * weight[0]), axis)
+            wsum = jax.lax.psum(jnp.sum(weight[0]), axis)
+            return lsum / jnp.maximum(wsum, 1.0)
+
+        from jax import shard_map
+        smapped = shard_map(
+            _block_loss, mesh=mesh,
+            in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=P())
+
+        def loss(params, batch):
+            base = smapped(params["w"], params["b"], batch["offset"],
+                           batch["index"], batch["value"], batch["label"],
+                           batch["weight"])
+            if self.l2:
+                base = base + self.l2 * jnp.sum(params["w"] ** 2)
+            return base
+        return loss
+
+    def make_sharded_train_step(self, mesh: Mesh, axis: str = "data"):
+        """jitted (params, global_batch) -> (params, loss); params
+        replicated, batch sharded on the data axis."""
+        loss_fn = self.global_loss_fn(mesh, axis)
+        replicated = NamedSharding(mesh, P())
+
+        @partial(jax.jit, out_shardings=(replicated, replicated))
+        def step(params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_params = jax.tree.map(
+                lambda p, g: p - self.learning_rate * g, params, grads)
+            return new_params, loss
+        return step
+
+    # -- inference helpers
+
+    def predict_proba(self, params, batch) -> jnp.ndarray:
+        return jax.nn.sigmoid(self.forward(params, batch))

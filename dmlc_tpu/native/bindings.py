@@ -1,0 +1,229 @@
+"""ctypes bindings for the native engine + Parser adapters.
+
+The native parsers implement the same Parser protocol as the Python
+golden (dmlc_tpu/data/parser.py) with byte-identical output (engine
+parity tests: tests/test_native.py). File listing and URI handling stay
+in Python (the VFS is the source of truth for shard layout); the native
+side owns reading, splitting, and parsing.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dmlc_tpu.data.parser import Parser
+from dmlc_tpu.data.rowblock import RowBlock
+from dmlc_tpu.io.input_split import list_split_files
+from dmlc_tpu.io.uri_spec import URISpec
+from dmlc_tpu.utils.logging import DMLCError, check
+
+__all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
+           "NativeCSVParser", "NativeLibFMParser", "native_parse_float32"]
+
+_lib = None
+
+
+def load(path: str):
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = C.CDLL(path)
+    lib.dtp_last_error.restype = C.c_char_p
+    lib.dtp_version.restype = C.c_int
+    lib.dtp_parser_create.restype = C.c_void_p
+    lib.dtp_parser_create.argtypes = [
+        C.POINTER(C.c_char_p), C.POINTER(C.c_int64), C.c_int64, C.c_int64,
+        C.c_int64, C.c_char_p, C.c_int, C.c_int64, C.c_int, C.c_int64,
+        C.c_int64, C.c_char,
+    ]
+    lib.dtp_parser_next.restype = C.c_int64
+    lib.dtp_parser_next.argtypes = [
+        C.c_void_p,
+        C.POINTER(C.POINTER(C.c_int64)),    # offset
+        C.POINTER(C.POINTER(C.c_float)),    # label
+        C.POINTER(C.POINTER(C.c_float)),    # weight
+        C.POINTER(C.POINTER(C.c_int64)),    # qid
+        C.POINTER(C.POINTER(C.c_uint32)),   # index32
+        C.POINTER(C.POINTER(C.c_uint64)),   # index64
+        C.POINTER(C.POINTER(C.c_float)),    # value
+        C.POINTER(C.POINTER(C.c_int64)),    # field
+        C.POINTER(C.c_int64),               # nnz
+        C.POINTER(C.c_int), C.POINTER(C.c_int), C.POINTER(C.c_int),
+    ]
+    lib.dtp_parser_before_first.argtypes = [C.c_void_p]
+    lib.dtp_parser_bytes_read.restype = C.c_int64
+    lib.dtp_parser_bytes_read.argtypes = [C.c_void_p]
+    lib.dtp_parser_total_size.restype = C.c_int64
+    lib.dtp_parser_total_size.argtypes = [C.c_void_p]
+    lib.dtp_parser_destroy.argtypes = [C.c_void_p]
+    lib.dtp_parse_float32.restype = C.c_int
+    lib.dtp_parse_float32.argtypes = [C.c_char_p, C.c_int64,
+                                      C.POINTER(C.c_float)]
+    lib.dtp_parse_float64.restype = C.c_int
+    lib.dtp_parse_float64.argtypes = [C.c_char_p, C.c_int64,
+                                      C.POINTER(C.c_double)]
+    _lib = lib
+    return lib
+
+
+def _get_lib():
+    from dmlc_tpu.native import get_lib
+    return get_lib()
+
+
+def native_parse_float32(token: bytes) -> np.float32:
+    """Engine-side float parse (parity probe against the Python golden)."""
+    lib = _get_lib()
+    out = C.c_float()
+    ok = lib.dtp_parse_float32(token, len(token), C.byref(out))
+    if not ok:
+        raise ValueError(f"native: invalid float literal {token!r}")
+    return np.float32(out.value)
+
+
+class NativeTextParser(Parser):
+    """Parser over the native pipeline (reader + parse threads in C++)."""
+
+    _format = "libsvm"
+
+    def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1,
+                 index_dtype=np.uint32, nthreads: Optional[int] = None,
+                 chunk_size: int = 8 << 20, **kwargs: Any):
+        lib = _get_lib()
+        self.uri = uri
+        self.index_dtype = np.dtype(index_dtype)
+        spec = URISpec(uri)
+        if spec.cache_file:
+            raise DMLCError(
+                "native engine does not support '#cache' URIs yet; "
+                "use engine='python' for cached splits")
+        files = list_split_files(uri)
+        for p, _ in files:
+            check(os.path.exists(p),
+                  f"native engine requires local files, got {p!r}")
+        paths = (C.c_char_p * len(files))(
+            *[p.encode() for p, _ in files])
+        sizes = (C.c_int64 * len(files))(*[s for _, s in files])
+        if nthreads is None:
+            nthreads = max(1, (os.cpu_count() or 1) - 1)
+        cfgerr = self._configure(kwargs)
+        if cfgerr:
+            raise DMLCError(cfgerr)
+        self._lib = lib
+        self._handle = lib.dtp_parser_create(
+            paths, sizes, len(files), part_index, num_parts,
+            self._format.encode(), int(nthreads), int(chunk_size),
+            int(self._indexing_mode), int(self._label_column),
+            int(self._weight_column), self._delimiter.encode()[:1])
+        if not self._handle:
+            raise DMLCError(
+                f"native parser create failed: "
+                f"{lib.dtp_last_error().decode()}")
+        self._block: Optional[RowBlock] = None
+
+    # format knobs; subclasses override
+    _indexing_mode = 0
+    _label_column = -1
+    _weight_column = -1
+    _delimiter = ","
+
+    def _configure(self, kwargs: Dict[str, Any]) -> Optional[str]:
+        self._indexing_mode = int(kwargs.pop("indexing_mode", 0))
+        self._label_column = int(kwargs.pop("label_column", -1))
+        self._weight_column = int(kwargs.pop("weight_column", -1))
+        self._delimiter = str(kwargs.pop("delimiter", ","))
+        kwargs.pop("engine", None)
+        kwargs.pop("prefetch", None)
+        kwargs.pop("format", None)
+        if kwargs:
+            return f"native parser: unknown parameter(s) {sorted(kwargs)}"
+        return None
+
+    def before_first(self) -> None:
+        self._lib.dtp_parser_before_first(self._handle)
+        self._block = None
+
+    def next(self) -> bool:
+        offset = C.POINTER(C.c_int64)()
+        label = C.POINTER(C.c_float)()
+        weight = C.POINTER(C.c_float)()
+        qid = C.POINTER(C.c_int64)()
+        index32 = C.POINTER(C.c_uint32)()
+        index64 = C.POINTER(C.c_uint64)()
+        value = C.POINTER(C.c_float)()
+        field = C.POINTER(C.c_int64)()
+        nnz = C.c_int64()
+        hw, hq, hf = C.c_int(), C.c_int(), C.c_int()
+        rows = self._lib.dtp_parser_next(
+            self._handle, C.byref(offset), C.byref(label), C.byref(weight),
+            C.byref(qid), C.byref(index32), C.byref(index64), C.byref(value),
+            C.byref(field), C.byref(nnz), C.byref(hw), C.byref(hq),
+            C.byref(hf))
+        if rows < 0:
+            raise DMLCError(
+                f"{self._format}: {self._lib.dtp_last_error().decode()}")
+        if rows == 0:
+            self._block = None
+            return False
+        n, z = int(rows), int(nnz.value)
+
+        def arr(ptr, count, dtype):
+            if count == 0:
+                return np.empty(0, dtype)
+            return np.ctypeslib.as_array(ptr, shape=(count,)).astype(
+                dtype, copy=True)
+
+        if index32:
+            index = arr(index32, z, np.uint32)
+        else:
+            index = arr(index64, z, np.uint64)
+        if self.index_dtype == np.uint64:
+            index = index.astype(np.uint64, copy=False)
+        self._block = RowBlock(
+            offset=arr(offset, n + 1, np.int64),
+            label=arr(label, n, np.float32),
+            index=index.astype(self.index_dtype, copy=False),
+            value=arr(value, z, np.float32),
+            weight=arr(weight, n, np.float32) if hw.value else None,
+            qid=arr(qid, n, np.int64) if hq.value else None,
+            field=arr(field, z, np.int64) if hf.value else None)
+        return True
+
+    def value(self) -> RowBlock:
+        check(self._block is not None, "value() before successful next()")
+        return self._block
+
+    def bytes_read(self) -> int:
+        return int(self._lib.dtp_parser_bytes_read(self._handle))
+
+    def destroy(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.dtp_parser_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+class NativeLibSVMParser(NativeTextParser):
+    _format = "libsvm"
+
+
+class NativeCSVParser(NativeTextParser):
+    _format = "csv"
+
+    def _configure(self, kwargs):
+        # csv defaults mirror CSVParserParam
+        kwargs.setdefault("label_column", -1)
+        return super()._configure(kwargs)
+
+
+class NativeLibFMParser(NativeTextParser):
+    _format = "libfm"

@@ -1,0 +1,35 @@
+"""Build the native engine: ``python -m dmlc_tpu.native.build``.
+
+Compiles native/src/engine.cc into libdmlc_tpu.so next to this file
+(g++ -O3; no external deps). The reference's CMake/Makefile build glue
+(CMakeLists.txt, make/dmlc.mk) maps to this single-step build plus
+pyproject.toml for the Python side.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "src", "engine.cc")
+OUT = os.path.join(HERE, "libdmlc_tpu.so")
+
+
+def build(verbose: bool = True) -> str:
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+        "-pthread", "-Wall", "-Wextra",
+        SRC, "-o", OUT,
+    ]
+    if verbose:
+        print("+", " ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    path = build()
+    print(f"built {path}")
+    sys.exit(0)

@@ -1,0 +1,805 @@
+// dmlc_tpu native engine: sharded text -> CSR parse pipeline.
+//
+// TPU-native re-design of the reference's hot path (reference:
+// src/io/input_split_base.cc, src/io/line_split.cc, src/data/text_parser.h,
+// src/data/{libsvm,csv,libfm}_parser.h, include/dmlc/strtonum.h,
+// include/dmlc/threadediter.h) — not a translation: one reader thread
+// produces whole-record chunks for this shard (same boundary contract as
+// the Python golden in dmlc_tpu/io/input_split.py), a pool of parser
+// threads converts chunks to CSR arenas, and an ordered bounded queue
+// hands blocks to the consumer in deterministic order, so output is
+// byte-identical to the single-threaded golden regardless of thread count.
+//
+// Frozen parse semantics (see dmlc_tpu/data/strtonum.py):
+//   float value  = (float)std::from_chars<double>  (nearest-double, then
+//                  cast to float32 — matches Python float() + np.float32)
+//   index        = std::from_chars<uint64>
+//   text record  = maximal run of bytes with no '\n'/'\r'
+//   whitespace   = ' ' or '\t' between tokens (locale-free)
+//
+// C ABI (ctypes): every entry point is extern "C"; blocks are owned by the
+// handle and valid until the next dtp_parser_next/destroy call.
+
+#include <algorithm>
+#include <atomic>
+#include <charconv>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- errors
+
+struct EngineError {
+  std::string msg;
+};
+
+// ---------------------------------------------------------------- strtonum
+
+// strtod semantics on top of from_chars: GCC reports ERANGE for both
+// underflow and overflow and leaves the value untouched; strtod (and the
+// Python golden) return ±0 on underflow and ±inf on overflow. The sign of
+// the estimated decimal exponent decides which (ERANGE can only happen at
+// |exp10| >> 0, so the estimate needs no precision).
+inline bool parse_f64(const char* b, const char* e, double* out) {
+  // strtod/Python accept a leading '+'; from_chars does not
+  if (b < e && *b == '+' && e - b > 1) ++b;
+  auto r = std::from_chars(b, e, *out);
+  if (r.ec == std::errc() && r.ptr == e) return true;
+  if (r.ec == std::errc::result_out_of_range && r.ptr == e) {
+    const char* p = b;
+    bool neg = (p < e && *p == '-');
+    if (p < e && (*p == '+' || *p == '-')) ++p;
+    long exp10 = 0, intdigits = 0, lead_zeros_frac = 0;
+    bool seen_point = false, seen_nonzero = false;
+    for (; p < e; ++p) {
+      char c = *p;
+      if (c == '.') { seen_point = true; continue; }
+      if (c == 'e' || c == 'E') {
+        ++p;
+        long ev = 0;
+        bool eneg = false;
+        if (p < e && (*p == '+' || *p == '-')) { eneg = (*p == '-'); ++p; }
+        for (; p < e && *p >= '0' && *p <= '9'; ++p)
+          if (ev < 1000000) ev = ev * 10 + (*p - '0');
+        exp10 += eneg ? -ev : ev;
+        break;
+      }
+      if (c < '0' || c > '9') break;
+      if (!seen_nonzero) {
+        if (c == '0') {
+          if (seen_point) ++lead_zeros_frac;
+          continue;
+        }
+        seen_nonzero = true;
+        if (!seen_point) intdigits = 1;
+        else exp10 -= lead_zeros_frac + 1;
+      } else if (!seen_point) {
+        ++intdigits;
+      }
+    }
+    if (intdigits > 0) exp10 += intdigits - 1;
+    double v = (exp10 > 0) ? HUGE_VAL : 0.0;
+    *out = neg ? -v : v;
+    return true;
+  }
+  return false;
+}
+
+inline bool parse_f32(const char* b, const char* e, float* out) {
+  double d;
+  if (!parse_f64(b, e, &d)) return false;
+  *out = static_cast<float>(d);
+  return true;
+}
+
+inline bool parse_u64(const char* b, const char* e, uint64_t* out) {
+  if (b < e && *b == '+' && e - b > 1) ++b;
+  auto r = std::from_chars(b, e, *out);
+  return r.ec == std::errc() && r.ptr == e;
+}
+
+inline bool parse_i64(const char* b, const char* e, int64_t* out) {
+  if (b < e && *b == '+' && e - b > 1) ++b;
+  auto r = std::from_chars(b, e, *out);
+  return r.ec == std::errc() && r.ptr == e;
+}
+
+inline bool is_ws(char c) { return c == ' ' || c == '\t'; }
+inline bool is_nl(char c) { return c == '\n' || c == '\r'; }
+
+// ---------------------------------------------------------------- CSR arena
+
+struct CSRArena {
+  std::vector<int64_t> offset{0};
+  std::vector<float> label;
+  std::vector<float> weight;
+  std::vector<int64_t> qid;
+  std::vector<uint64_t> index;  // widened; narrowed at the ABI if u32
+  std::vector<float> value;
+  std::vector<int64_t> field;
+  bool has_weight = false, has_qid = false, has_field = false;
+  uint64_t min_index = UINT64_MAX;
+
+  size_t rows() const { return label.size(); }
+  size_t nnz() const { return index.size(); }
+
+  void append(CSRArena&& o) {
+    int64_t base = offset.back();
+    offset.reserve(offset.size() + o.rows());
+    for (size_t i = 1; i < o.offset.size(); ++i)
+      offset.push_back(base + o.offset[i]);
+    auto cat = [](auto& dst, auto& src) {
+      dst.insert(dst.end(), src.begin(), src.end());
+    };
+    cat(label, o.label); cat(weight, o.weight); cat(qid, o.qid);
+    cat(index, o.index); cat(value, o.value); cat(field, o.field);
+    has_weight |= o.has_weight; has_qid |= o.has_qid; has_field |= o.has_field;
+    min_index = std::min(min_index, o.min_index);
+  }
+};
+
+// ------------------------------------------------------------- file shard
+// Same contract as dmlc_tpu.io.input_split._AlignedSplitBase (text):
+// global concatenation, nstep = ceil(total/nparts), boundary(x) scans
+// through the next newline run, clipped at the containing file's end.
+
+struct FileEntry {
+  std::string path;
+  int64_t size;
+};
+
+class TextShardReader {
+ public:
+  TextShardReader(std::vector<FileEntry> files, int64_t part, int64_t nparts,
+                  int64_t chunk_bytes)
+      : files_(std::move(files)), chunk_bytes_(std::max<int64_t>(
+            chunk_bytes, 64 * 1024)) {
+    prefix_.push_back(0);
+    for (auto& f : files_) prefix_.push_back(prefix_.back() + f.size);
+    total_ = prefix_.back();
+    int64_t nstep = (total_ + nparts - 1) / nparts;
+    int64_t raw_b = std::min(nstep * part, total_);
+    int64_t raw_e = std::min(nstep * (part + 1), total_);
+    begin_ = boundary(raw_b);
+    end_ = boundary(raw_e);
+    Reset();
+  }
+  ~TextShardReader() { CloseFile(); }
+
+  void Reset() {
+    CloseFile();
+    cur_ = begin_;
+    leftover_.clear();
+    bytes_read_ = 0;
+  }
+
+  int64_t total_size() const { return total_; }
+  int64_t bytes_read() const { return bytes_read_; }
+
+  // Next buffer of whole records; false at end of shard.
+  bool NextChunk(std::string* out) {
+    out->clear();
+    while (true) {
+      if (cur_ >= end_ && leftover_.empty()) return false;
+      if (!fp_ && cur_ < end_) OpenAt(cur_);
+      int64_t want = std::min<int64_t>(
+          chunk_bytes_, std::min(file_end_ - cur_, end_ - cur_));
+      std::string raw(want > 0 ? want : 0, '\0');
+      if (want > 0) {
+        size_t got = fread(raw.data(), 1, (size_t)want, fp_);
+        raw.resize(got);
+      }
+      bytes_read_ += (int64_t)raw.size();
+      cur_ += (int64_t)raw.size();
+      bool at_file_end = cur_ >= std::min(file_end_, end_);
+      std::string combined = leftover_.empty() ? std::move(raw)
+                                               : leftover_ + raw;
+      leftover_.clear();
+      if (at_file_end) {
+        CloseFile();
+        if (cur_ >= end_) cur_ = end_;
+        if (!combined.empty()) {
+          *out = std::move(combined);
+          return true;
+        }
+        continue;
+      }
+      // cut at last newline; carry the partial tail
+      size_t cut = combined.find_last_of("\n\r");
+      if (cut == std::string::npos) {
+        leftover_ = std::move(combined);
+        continue;
+      }
+      leftover_ = combined.substr(cut + 1);
+      combined.resize(cut + 1);
+      *out = std::move(combined);
+      return true;
+    }
+  }
+
+ private:
+  void CloseFile() {
+    if (fp_) { fclose(fp_); fp_ = nullptr; }
+  }
+
+  int FileIndexOf(int64_t gpos) const {
+    // last i with prefix_[i] <= gpos
+    int lo = 0, hi = (int)files_.size();
+    while (lo + 1 < hi) {
+      int mid = (lo + hi) / 2;
+      if (prefix_[mid] <= gpos) lo = mid; else hi = mid;
+    }
+    return lo;
+  }
+
+  void OpenAt(int64_t gpos) {
+    int i = FileIndexOf(gpos);
+    fp_ = fopen(files_[i].path.c_str(), "rb");
+    if (!fp_) throw EngineError{"cannot open " + files_[i].path};
+    file_end_ = prefix_[i + 1];
+    if (fseeko(fp_, gpos - prefix_[i], SEEK_SET) != 0)
+      throw EngineError{"seek failed in " + files_[i].path};
+  }
+
+  // first record start at-or-after raw offset x (the shared rule)
+  int64_t boundary(int64_t x) {
+    if (x <= 0) return 0;
+    if (x >= total_) return total_;
+    int i = FileIndexOf(x);
+    if (x == prefix_[i]) return x;  // file boundary
+    FILE* f = fopen(files_[i].path.c_str(), "rb");
+    if (!f) throw EngineError{"cannot open " + files_[i].path};
+    fseeko(f, x - prefix_[i], SEEK_SET);
+    int64_t skipped = 0;
+    bool found_nl = false;
+    char buf[65536];
+    bool done = false;
+    while (!done) {
+      size_t got = fread(buf, 1, sizeof(buf), f);
+      if (got == 0) break;
+      for (size_t k = 0; k < got; ++k) {
+        if (!found_nl) {
+          ++skipped;
+          if (is_nl(buf[k])) found_nl = true;
+        } else if (is_nl(buf[k])) {
+          ++skipped;
+        } else {
+          done = true;
+          break;
+        }
+      }
+    }
+    fclose(f);
+    return std::min(x + skipped, prefix_[i + 1]);
+  }
+
+  std::vector<FileEntry> files_;
+  std::vector<int64_t> prefix_;
+  int64_t total_ = 0, begin_ = 0, end_ = 0, cur_ = 0;
+  int64_t chunk_bytes_, file_end_ = 0, bytes_read_ = 0;
+  FILE* fp_ = nullptr;
+  std::string leftover_;
+};
+
+// ----------------------------------------------------------- format parse
+
+enum class Format { kLibSVM, kCSV, kLibFM };
+
+struct ParserConfig {
+  Format format = Format::kLibSVM;
+  int indexing_mode = 0;  // 0 as-is, 1 one-based, -1 auto
+  long label_column = -1;
+  long weight_column = -1;
+  char delimiter = ',';
+};
+
+// parse [b, e) of whole text records into arena; throws EngineError
+void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
+  // reserve from density heuristics to avoid realloc churn
+  size_t bytes = (size_t)(e - b);
+  a->label.reserve(bytes / 64);
+  a->weight.reserve(bytes / 64);
+  a->qid.reserve(bytes / 64);
+  a->offset.reserve(bytes / 64 + 1);
+  a->index.reserve(bytes / 12);
+  a->value.reserve(bytes / 12);
+  const char* p = b;
+  while (p < e) {
+    while (p < e && is_nl(*p)) ++p;
+    const char* line_end = p;
+    while (line_end < e && !is_nl(*line_end)) ++line_end;
+    const char* q = p;
+    p = line_end;
+    // tokens within [q, line_end)
+    while (q < line_end && is_ws(*q)) ++q;
+    if (q == line_end) continue;  // blank line
+    const char* tok_end = q;
+    while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
+    float label;
+    if (!parse_f32(q, tok_end, &label))
+      throw EngineError{"libsvm: bad label '" + std::string(q, tok_end) + "'"};
+    int64_t qid = -1;
+    q = tok_end;
+    size_t row_nnz = 0;
+    bool seen_feature = false;
+    while (true) {
+      while (q < line_end && is_ws(*q)) ++q;
+      if (q >= line_end) break;
+      tok_end = q;
+      while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
+      // qid: only directly after the label (golden parity)
+      if (!seen_feature && tok_end - q > 4 &&
+          std::memcmp(q, "qid:", 4) == 0) {
+        if (!parse_i64(q + 4, tok_end, &qid))
+          throw EngineError{"libsvm: bad qid token '" +
+                            std::string(q, tok_end) + "'"};
+        a->has_qid = true;
+        q = tok_end;
+        continue;
+      }
+      const char* colon = tok_end;
+      for (const char* c = tok_end - 1; c > q; --c)
+        if (*c == ':') { colon = c; break; }
+      uint64_t idx;
+      float val;
+      if (colon == tok_end || !parse_u64(q, colon, &idx) ||
+          !parse_f32(colon + 1, tok_end, &val))
+        throw EngineError{"libsvm: bad feature token '" +
+                          std::string(q, tok_end) + "'"};
+      a->index.push_back(idx);
+      a->value.push_back(val);
+      a->min_index = std::min(a->min_index, idx);
+      ++row_nnz;
+      seen_feature = true;
+      q = tok_end;
+    }
+    a->label.push_back(label);
+    a->weight.push_back(1.0f);
+    a->qid.push_back(qid);
+    a->offset.push_back(a->offset.back() + (int64_t)row_nnz);
+  }
+}
+
+void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
+                   std::atomic<long>* ncol_atom, CSRArena* a) {
+  const char* p = b;
+  while (p < e) {
+    while (p < e && is_nl(*p)) ++p;
+    const char* line_end = p;
+    while (line_end < e && !is_nl(*line_end)) ++line_end;
+    const char* q = p;
+    p = line_end;
+    if (q == line_end) continue;
+    float label = 0.0f, weight = 1.0f;
+    long col = 0, fidx = 0;
+    size_t row_nnz = 0;
+    const char* cell = q;
+    bool row_done = false;
+    while (!row_done) {
+      const char* cell_end = cell;
+      while (cell_end < line_end && *cell_end != cfg.delimiter) ++cell_end;
+      // tolerate surrounding whitespace in cells (golden: Python float())
+      const char* vb = cell;
+      const char* ve = cell_end;
+      while (vb < ve && is_ws(*vb)) ++vb;
+      while (ve > vb && is_ws(*(ve - 1))) --ve;
+      float v;
+      if (!parse_f32(vb, ve, &v))
+        throw EngineError{"csv: bad value '" +
+                          std::string(cell, cell_end) + "'"};
+      if (col == cfg.label_column) {
+        label = v;
+      } else if (col == cfg.weight_column) {
+        weight = v;
+      } else {
+        a->index.push_back((uint64_t)fidx);
+        a->value.push_back(v);
+        ++fidx;
+        ++row_nnz;
+      }
+      ++col;
+      if (cell_end >= line_end) row_done = true;
+      cell = cell_end + 1;
+    }
+    long expect = ncol_atom->load(std::memory_order_relaxed);
+    if (expect == -1) {
+      long desired = -1;
+      if (ncol_atom->compare_exchange_strong(desired, col))
+        expect = col;
+      else
+        expect = ncol_atom->load(std::memory_order_relaxed);
+    }
+    if (col != expect)
+      throw EngineError{"csv: non-uniform number of columns (" +
+                        std::to_string(col) + " vs " + std::to_string(expect) +
+                        ")"};
+    if (cfg.weight_column >= 0) a->has_weight = true;
+    if (row_nnz) a->min_index = 0;
+    a->label.push_back(label);
+    a->weight.push_back(weight);
+    a->qid.push_back(-1);
+    a->offset.push_back(a->offset.back() + (int64_t)row_nnz);
+  }
+}
+
+void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
+  const char* p = b;
+  while (p < e) {
+    while (p < e && is_nl(*p)) ++p;
+    const char* line_end = p;
+    while (line_end < e && !is_nl(*line_end)) ++line_end;
+    const char* q = p;
+    p = line_end;
+    while (q < line_end && is_ws(*q)) ++q;
+    if (q == line_end) continue;
+    const char* tok_end = q;
+    while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
+    float label;
+    if (!parse_f32(q, tok_end, &label))
+      throw EngineError{"libfm: bad label '" + std::string(q, tok_end) + "'"};
+    q = tok_end;
+    size_t row_nnz = 0;
+    while (true) {
+      while (q < line_end && is_ws(*q)) ++q;
+      if (q >= line_end) break;
+      tok_end = q;
+      while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
+      const char* c1 = nullptr;
+      const char* c2 = nullptr;
+      for (const char* c = q; c < tok_end; ++c)
+        if (*c == ':') { if (!c1) c1 = c; else { c2 = c; break; } }
+      int64_t fld;
+      uint64_t idx;
+      float val;
+      if (!c1 || !c2 || !parse_i64(q, c1, &fld) ||
+          !parse_u64(c1 + 1, c2, &idx) || !parse_f32(c2 + 1, tok_end, &val))
+        throw EngineError{"libfm: bad token '" + std::string(q, tok_end) +
+                          "' (want field:idx:val)"};
+      a->field.push_back(fld);
+      a->index.push_back(idx);
+      a->value.push_back(val);
+      a->min_index = std::min(a->min_index, idx);
+      ++row_nnz;
+      q = tok_end;
+    }
+    a->has_field = true;
+    a->label.push_back(label);
+    a->weight.push_back(1.0f);
+    a->qid.push_back(-1);
+    a->offset.push_back(a->offset.back() + (int64_t)row_nnz);
+  }
+}
+
+// Split a chunk at record boundaries into ~nslices and parse in the
+// calling thread pool slot; slices stitched in order (reference:
+// TextParserBase OpenMP ParseBlock + FillData stitch).
+CSRArena ParseChunk(const std::string& chunk, const ParserConfig& cfg,
+                    std::atomic<long>* ncol_atom, int nslices) {
+  const char* b = chunk.data();
+  const char* e = b + chunk.size();
+  std::vector<std::pair<const char*, const char*>> slices;
+  if (nslices <= 1 || chunk.size() < (size_t)(64 << 10)) {
+    slices.emplace_back(b, e);
+  } else {
+    size_t step = chunk.size() / nslices;
+    const char* s = b;
+    for (int i = 1; i < nslices && s < e; ++i) {
+      const char* cut = b + step * i;
+      if (cut <= s) continue;
+      while (cut < e && !is_nl(*cut)) ++cut;
+      while (cut < e && is_nl(*cut)) ++cut;
+      slices.emplace_back(s, cut);
+      s = cut;
+    }
+    if (s < e) slices.emplace_back(s, e);
+  }
+  std::vector<CSRArena> parts(slices.size());
+  std::vector<std::string> errors(slices.size());
+  std::vector<std::thread> threads;
+  auto work = [&](size_t i) {
+    try {
+      switch (cfg.format) {
+        case Format::kLibSVM:
+          ParseLibSVMSlice(slices[i].first, slices[i].second, &parts[i]);
+          break;
+        case Format::kCSV:
+          ParseCSVSlice(slices[i].first, slices[i].second, cfg, ncol_atom,
+                        &parts[i]);
+          break;
+        case Format::kLibFM:
+          ParseLibFMSlice(slices[i].first, slices[i].second, &parts[i]);
+          break;
+      }
+    } catch (const EngineError& err) {
+      errors[i] = err.msg;
+    }
+  };
+  if (slices.size() == 1) {
+    work(0);
+  } else {
+    for (size_t i = 1; i < slices.size(); ++i)
+      threads.emplace_back(work, i);
+    work(0);
+    for (auto& t : threads) t.join();
+  }
+  for (auto& err : errors)
+    if (!err.empty()) throw EngineError{err};
+  CSRArena out = std::move(parts[0]);
+  for (size_t i = 1; i < parts.size(); ++i) out.append(std::move(parts[i]));
+  return out;
+}
+
+// ------------------------------------------------------------- pipeline
+// reader thread -> chunk queue -> parser threads -> ordered block queue
+// (reference: ThreadedInputSplit + ThreadedIter; exceptions propagate to
+// the consumer's next(), reference unittest_threaditer_exc_handling).
+
+template <typename T>
+class BoundedQueue {
+ public:
+  explicit BoundedQueue(size_t cap) : cap_(cap) {}
+
+  bool Push(T&& v) {  // false if killed
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_full_.wait(lk, [&] { return q_.size() < cap_ || killed_; });
+    if (killed_) return false;
+    q_.push_back(std::move(v));
+    cv_empty_.notify_one();
+    return true;
+  }
+
+  bool Pop(T* out) {  // false if killed or finished-and-empty
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_empty_.wait(lk, [&] { return !q_.empty() || killed_ || finished_; });
+    if (!q_.empty()) {
+      *out = std::move(q_.front());
+      q_.pop_front();
+      cv_full_.notify_one();
+      return true;
+    }
+    return false;
+  }
+
+  void Finish() {
+    std::lock_guard<std::mutex> lk(mu_);
+    finished_ = true;
+    cv_empty_.notify_all();
+  }
+
+  void Kill() {
+    std::lock_guard<std::mutex> lk(mu_);
+    killed_ = true;
+    cv_empty_.notify_all();
+    cv_full_.notify_all();
+  }
+
+  void Reset() {
+    std::lock_guard<std::mutex> lk(mu_);
+    q_.clear();
+    killed_ = false;
+    finished_ = false;
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_empty_, cv_full_;
+  std::deque<T> q_;
+  size_t cap_;
+  bool killed_ = false, finished_ = false;
+};
+
+struct ParserHandle {
+  ParserConfig cfg;
+  std::unique_ptr<TextShardReader> reader;
+  int nthreads = 1;
+
+  // pipeline state (rebuilt on BeforeFirst)
+  std::unique_ptr<std::thread> worker;
+  std::unique_ptr<BoundedQueue<std::pair<std::unique_ptr<CSRArena>,
+                                         std::string>>> blocks;
+  std::unique_ptr<CSRArena> current;        // block handed to consumer
+  std::vector<uint32_t> index32;            // narrowed view storage
+  std::atomic<long> ncol{-1};
+  int resolved_mode = 0;
+  bool mode_resolved = false;
+  std::string error;
+
+  ~ParserHandle() { StopPipeline(); }
+
+  void StopPipeline() {
+    if (blocks) blocks->Kill();
+    if (worker && worker->joinable()) worker->join();
+    worker.reset();
+    blocks.reset();
+  }
+
+  void StartPipeline() {
+    StopPipeline();
+    reader->Reset();
+    blocks = std::make_unique<BoundedQueue<
+        std::pair<std::unique_ptr<CSRArena>, std::string>>>(4);
+    worker = std::make_unique<std::thread>([this] {
+      try {
+        std::string chunk;
+        while (reader->NextChunk(&chunk)) {
+          auto arena = std::make_unique<CSRArena>(
+              ParseChunk(chunk, cfg, &ncol, nthreads));
+          if (!blocks->Push({std::move(arena), std::string()})) return;
+        }
+        blocks->Finish();
+      } catch (const EngineError& err) {
+        blocks->Push({nullptr, err.msg});
+        blocks->Finish();
+      } catch (const std::exception& ex) {
+        blocks->Push({nullptr, std::string(ex.what())});
+        blocks->Finish();
+      }
+    });
+  }
+
+  // returns rows; 0 = end; -1 = error (message in this->error)
+  int64_t Next() {
+    if (!blocks) StartPipeline();
+    std::pair<std::unique_ptr<CSRArena>, std::string> item;
+    while (blocks->Pop(&item)) {
+      if (!item.first) {
+        error = item.second;
+        return -1;
+      }
+      std::unique_ptr<CSRArena> a = std::move(item.first);
+      if (!mode_resolved) {
+        if (cfg.indexing_mode == -1)
+          resolved_mode =
+              (a->nnz() == 0 || a->min_index == 0) ? 0 : 1;
+        else
+          resolved_mode = cfg.indexing_mode;
+        mode_resolved = true;
+      }
+      if (resolved_mode == 1) {
+        if (a->nnz() && a->min_index == 0) {
+          error = "index 0 found with indexing_mode=1";
+          return -1;
+        }
+        for (auto& ix : a->index) ix -= 1;
+      }
+      if (a->rows() == 0) continue;  // skip empty blocks
+      current = std::move(a);
+      return (int64_t)current->rows();
+    }
+    return 0;
+  }
+};
+
+Format parse_format(const char* fmt) {
+  std::string f(fmt);
+  if (f == "libsvm") return Format::kLibSVM;
+  if (f == "csv") return Format::kCSV;
+  if (f == "libfm") return Format::kLibFM;
+  throw EngineError{"unknown native format: " + f};
+}
+
+thread_local std::string g_last_error;
+
+}  // namespace
+
+// ----------------------------------------------------------------- C ABI
+
+extern "C" {
+
+const char* dtp_last_error() { return g_last_error.c_str(); }
+
+int dtp_version() { return 1; }
+
+// files: paths array; sizes must match the Python VFS listing so the
+// shard contract is identical across engines.
+void* dtp_parser_create(const char** paths, const int64_t* sizes,
+                        int64_t nfiles, int64_t part, int64_t nparts,
+                        const char* format, int nthreads,
+                        int64_t chunk_bytes, int indexing_mode,
+                        int64_t label_column, int64_t weight_column,
+                        char delimiter) {
+  try {
+    auto h = std::make_unique<ParserHandle>();
+    h->cfg.format = parse_format(format);
+    h->cfg.indexing_mode = indexing_mode;
+    h->cfg.label_column = label_column;
+    h->cfg.weight_column = weight_column;
+    h->cfg.delimiter = delimiter;
+    h->nthreads = std::max(1, nthreads);
+    std::vector<FileEntry> files;
+    for (int64_t i = 0; i < nfiles; ++i)
+      files.push_back({paths[i], sizes[i]});
+    h->reader = std::make_unique<TextShardReader>(
+        std::move(files), part, nparts, chunk_bytes);
+    return h.release();
+  } catch (const EngineError& e) {
+    g_last_error = e.msg;
+    return nullptr;
+  }
+}
+
+// Pull next block. Returns rows (>0), 0 at end, -1 on error
+// (dtp_last_error). Pointers valid until the next call on this handle.
+int64_t dtp_parser_next(void* handle, const int64_t** offset,
+                        const float** label, const float** weight,
+                        const int64_t** qid, const uint32_t** index32,
+                        const uint64_t** index64, const float** value,
+                        const int64_t** field, int64_t* nnz,
+                        int* has_weight, int* has_qid, int* has_field) {
+  auto* h = static_cast<ParserHandle*>(handle);
+  int64_t rows = h->Next();
+  if (rows < 0) {
+    g_last_error = h->error;
+    return -1;
+  }
+  if (rows == 0) return 0;
+  CSRArena* a = h->current.get();
+  *offset = a->offset.data();
+  *label = a->label.data();
+  *weight = a->weight.data();
+  *qid = a->qid.data();
+  *value = a->value.data();
+  *field = a->has_field ? a->field.data() : nullptr;
+  *nnz = (int64_t)a->nnz();
+  // narrow index to u32 when it fits (the default RowBlock dtype)
+  bool fits32 = true;
+  for (uint64_t ix : a->index)
+    if (ix > UINT32_MAX) { fits32 = false; break; }
+  if (fits32) {
+    h->index32.resize(a->index.size());
+    for (size_t i = 0; i < a->index.size(); ++i)
+      h->index32[i] = (uint32_t)a->index[i];
+    *index32 = h->index32.data();
+    *index64 = nullptr;
+  } else {
+    *index32 = nullptr;
+    *index64 = a->index.data();
+  }
+  *has_weight = a->has_weight ? 1 : 0;
+  *has_qid = a->has_qid ? 1 : 0;
+  *has_field = a->has_field ? 1 : 0;
+  return rows;
+}
+
+void dtp_parser_before_first(void* handle) {
+  auto* h = static_cast<ParserHandle*>(handle);
+  h->StopPipeline();
+  h->ncol.store(-1);
+  h->mode_resolved = false;
+  h->current.reset();
+  // pipeline restarts lazily on next()
+}
+
+int64_t dtp_parser_bytes_read(void* handle) {
+  return static_cast<ParserHandle*>(handle)->reader->bytes_read();
+}
+
+int64_t dtp_parser_total_size(void* handle) {
+  return static_cast<ParserHandle*>(handle)->reader->total_size();
+}
+
+void dtp_parser_destroy(void* handle) {
+  delete static_cast<ParserHandle*>(handle);
+}
+
+// strtonum parity probes (tests compare against the Python golden)
+int dtp_parse_float32(const char* s, int64_t len, float* out) {
+  return parse_f32(s, s + len, out) ? 1 : 0;
+}
+
+int dtp_parse_float64(const char* s, int64_t len, double* out) {
+  return parse_f64(s, s + len, out) ? 1 : 0;
+}
+
+}  // extern "C"

@@ -114,8 +114,8 @@ def sharded_spmv(batch, weights, mesh, axis: str = "data"):
     the same way — the canonical consumption pattern for downstream
     learners (per-device partial results, psum-able gradients).
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
 
     row_bucket = batch["offset"].shape[1] - 1
 

@@ -1,0 +1,245 @@
+"""Job launcher + rendezvous: the TPU-native `tracker/` equivalent.
+
+Reference: tracker/dmlc_tracker/{submit,opts,tracker,local,ssh,mpi}.py —
+dmlc-submit CLI, RabitTracker rendezvous (rank assignment + ring/tree
+topologies over sockets), env-var contract (DMLC_TRACKER_URI, DMLC_ROLE,
+DMLC_TASK_ID, DMLC_NUM_WORKER, ...).
+
+TPU-native mapping (SURVEY.md §2.4/§5.8): the entire tracker job — workers
+find a coordinator, get a rank, learn the world size — is
+jax.distributed.initialize(coordinator_address, num_processes,
+process_id). This module provides:
+
+- the env contract (DMLC_TPU_COORDINATOR_URI/NUM_WORKER/TASK_ID, with the
+  reference's DMLC_* names accepted as aliases so reference-style
+  launchers keep working),
+- ``init_from_env()`` — worker-side rendezvous,
+- ``launch_local()`` — N local processes (the reference's --cluster local,
+  and how multi-host tests run without a cluster),
+- ``launch_ssh()`` — command generation for bare-metal clusters,
+- ring/tree topology helpers for API parity with RabitTracker
+  (get_ring/get_tree/get_link_map). On TPU these are informational —
+  XLA picks collective topology — but downstream code that asks for
+  them keeps working.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dmlc_tpu.utils.logging import DMLCError, check
+
+__all__ = [
+    "worker_envs", "init_from_env", "finalize", "launch_local",
+    "launch_ssh", "get_ring", "get_tree", "get_link_map", "find_free_port",
+    "main",
+]
+
+# env contract (reference: slave_envs in tracker.py)
+ENV_COORD = "DMLC_TPU_COORDINATOR_URI"
+ENV_NWORKER = "DMLC_TPU_NUM_WORKER"
+ENV_TASK_ID = "DMLC_TPU_TASK_ID"
+# reference-name aliases accepted on read
+_ALIASES = {
+    ENV_COORD: ["DMLC_TRACKER_URI"],
+    ENV_NWORKER: ["DMLC_NUM_WORKER"],
+    ENV_TASK_ID: ["DMLC_TASK_ID"],
+}
+
+
+def _getenv(name: str) -> Optional[str]:
+    v = os.environ.get(name)
+    if v:
+        return v
+    for alias in _ALIASES.get(name, []):
+        v = os.environ.get(alias)
+        if v:
+            return v
+    return None
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def worker_envs(coordinator: str, num_workers: int,
+                task_id: int) -> Dict[str, str]:
+    """The env block handed to each worker (reference: slave_envs +
+    per-worker DMLC_TASK_ID). Reference names are set too, for
+    downstream code that reads them."""
+    check(":" in coordinator,
+          f"coordinator must be host:port, got {coordinator!r}")
+    return {
+        ENV_COORD: coordinator,
+        ENV_NWORKER: str(num_workers),
+        ENV_TASK_ID: str(task_id),
+        "DMLC_TRACKER_URI": coordinator.rsplit(":", 1)[0],
+        "DMLC_TRACKER_PORT": coordinator.rsplit(":", 1)[1],
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_TASK_ID": str(task_id),
+        "DMLC_ROLE": "worker",
+    }
+
+
+def init_from_env(force: bool = False) -> Tuple[int, int]:
+    """Worker-side rendezvous: jax.distributed.initialize from the env
+    contract. Returns (process_id, num_processes). No-op (returning
+    jax's current values) when the env is absent — single-process mode.
+    """
+    import jax
+    coord = _getenv(ENV_COORD)
+    if coord is None and not force:
+        return jax.process_index(), jax.process_count()
+    check(coord is not None, f"{ENV_COORD} not set")
+    nworker = int(_getenv(ENV_NWORKER) or "1")
+    task_id = int(_getenv(ENV_TASK_ID) or "0")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nworker,
+                               process_id=task_id)
+    return task_id, nworker
+
+
+def finalize() -> None:
+    """Synchronize all processes and shut the rendezvous down cleanly.
+
+    Call at worker exit: without the barrier the coordinator (rank 0) can
+    exit while peers are mid-handshake, turning a clean run into nonzero
+    exit codes (the reference tracker solves this with its N-"shutdown"
+    accept loop in tracker.py)."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dmlc_tpu_finalize")
+        jax.distributed.shutdown()
+
+
+def launch_local(num_workers: int, command: Sequence[str],
+                 env: Optional[Dict[str, str]] = None,
+                 coordinator: Optional[str] = None,
+                 timeout: Optional[float] = None) -> List[int]:
+    """Run N worker processes on this host (reference: local.py).
+
+    Returns the list of exit codes (order = task id). Raises if any
+    worker fails.
+    """
+    check(num_workers >= 1, "num_workers must be >= 1")
+    if coordinator is None:
+        coordinator = f"127.0.0.1:{find_free_port()}"
+    import time as _time
+    procs = []
+    for task_id in range(num_workers):
+        wenv = dict(os.environ)
+        if env:
+            wenv.update(env)
+        wenv.update(worker_envs(coordinator, num_workers, task_id))
+        procs.append(subprocess.Popen(list(command), env=wenv))
+    deadline = _time.monotonic() + timeout if timeout else None
+    codes: List[Optional[int]] = []
+    try:
+        for p in procs:
+            remaining = (deadline - _time.monotonic()) if deadline else None
+            codes.append(p.wait(timeout=remaining))
+    except subprocess.TimeoutExpired:
+        for p in procs:  # kill the whole gang, leak nothing
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
+        raise DMLCError(
+            f"workers exceeded timeout {timeout}s; all killed") from None
+    if any(codes):
+        raise DMLCError(f"worker failure, exit codes {codes}")
+    return codes
+
+
+def launch_ssh(hosts: Sequence[str], command: Sequence[str],
+               coordinator: str, num_workers: Optional[int] = None,
+               dry_run: bool = False) -> List[str]:
+    """Generate (and optionally run) per-host ssh commands
+    (reference: ssh.py). Returns the command lines."""
+    n = num_workers or len(hosts)
+    lines = []
+    for task_id in range(n):
+        host = hosts[task_id % len(hosts)]
+        envs = worker_envs(coordinator, n, task_id)
+        env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in envs.items())
+        cmd_str = " ".join(shlex.quote(c) for c in command)
+        lines.append(f"ssh -o StrictHostKeyChecking=no {host} "
+                     f"'cd {shlex.quote(os.getcwd())} && "
+                     f"env {env_str} {cmd_str}'")
+    if not dry_run:
+        procs = [subprocess.Popen(line, shell=True) for line in lines]
+        codes = [p.wait() for p in procs]
+        if any(codes):
+            raise DMLCError(f"ssh worker failure, exit codes {codes}")
+    return lines
+
+
+# ---------------------------------------------------------------- topology
+# Reference: tracker.py get_ring/get_tree/get_link_map (RabitTracker).
+# Pure functions; properties tested in tests/test_launch.py.
+
+def get_ring(n: int) -> Dict[int, Tuple[int, int]]:
+    """rank -> (prev, next) on a ring (reference: get_ring)."""
+    check(n >= 1, "ring needs n >= 1")
+    return {r: ((r - 1) % n, (r + 1) % n) for r in range(n)}
+
+
+def get_tree(n: int) -> Dict[int, int]:
+    """rank -> parent (-1 for root) on a binary tree (reference: get_tree)."""
+    check(n >= 1, "tree needs n >= 1")
+    return {r: ((r - 1) // 2 if r else -1) for r in range(n)}
+
+
+def get_link_map(n: int) -> Dict[int, List[int]]:
+    """rank -> neighbor list combining tree links (reference: get_link_map)."""
+    parent = get_tree(n)
+    links: Dict[int, List[int]] = {r: [] for r in range(n)}
+    for r, p in parent.items():
+        if p >= 0:
+            links[r].append(p)
+            links[p].append(r)
+    return links
+
+
+# ---------------------------------------------------------------- CLI
+# Reference: tracker/dmlc-submit + submit.py/opts.py
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="dmlc-tpu-submit",
+        description="Launch distributed workers "
+                    "(reference: dmlc-submit; TPU-native rendezvous)")
+    ap.add_argument("--cluster", choices=["local", "ssh"], default="local")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--host-file", default=None,
+                    help="one host per line (ssh cluster)")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of rank-0 coordinator")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    check(len(args.command) > 0, "no worker command given")
+    cmd = args.command[1:] if args.command[0] == "--" else args.command
+    if args.cluster == "local":
+        launch_local(args.num_workers, cmd, coordinator=args.coordinator)
+    else:
+        check(args.host_file is not None, "--host-file required for ssh")
+        with open(args.host_file) as f:
+            hosts = [h.strip() for h in f if h.strip()]
+        # port chosen by local probe; it must be free on hosts[0] too —
+        # pass --coordinator to control it explicitly
+        coord = args.coordinator or f"{hosts[0]}:{find_free_port()}"
+        launch_ssh(hosts, cmd, coord, num_workers=args.num_workers)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,7 +78,10 @@ def read_str(s: Stream) -> str:
 
 def write_ndarray(s: Stream, a: np.ndarray) -> None:
     """dtype-string + shape + raw LE bytes (the POD-vector fast path)."""
-    a = np.ascontiguousarray(a)
+    a = np.asarray(a)
+    if a.ndim and not a.flags.c_contiguous:
+        # (ascontiguousarray would silently promote 0-d to shape (1,))
+        a = np.ascontiguousarray(a)
     dt = a.dtype.newbyteorder("<")
     write_str(s, dt.str)
     write_u8(s, a.ndim)

@@ -7,11 +7,17 @@ is tested on a virtual CPU mesh so CI needs no TPU.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# the env var alone is overridden by this machine's axon TPU plugin;
+# the config update is authoritative
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

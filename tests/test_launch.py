@@ -1,0 +1,129 @@
+"""Launcher + rendezvous (reference: tracker/dmlc_tracker — local backend,
+env contract, ring/tree topology)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dmlc_tpu.parallel.launch import (
+    find_free_port, get_link_map, get_ring, get_tree, launch_local,
+    launch_ssh, worker_envs, main,
+)
+from dmlc_tpu.utils.logging import DMLCError
+
+
+class TestTopology:
+    @pytest.mark.parametrize("n", [1, 2, 3, 8, 17])
+    def test_ring_properties(self, n):
+        ring = get_ring(n)
+        assert len(ring) == n
+        for r, (prev, nxt) in ring.items():
+            assert ring[nxt][0] == r  # my next's prev is me
+            assert ring[prev][1] == r
+        # walking next pointers visits every rank once
+        seen, r = [], 0
+        for _ in range(n):
+            seen.append(r)
+            r = ring[r][1]
+        assert sorted(seen) == list(range(n)) and r == 0
+
+    @pytest.mark.parametrize("n", [1, 2, 5, 16])
+    def test_tree_properties(self, n):
+        tree = get_tree(n)
+        assert tree[0] == -1
+        for r in range(1, n):
+            assert 0 <= tree[r] < r  # parents precede children: acyclic
+        links = get_link_map(n)
+        assert sum(len(v) for v in links.values()) == 2 * (n - 1)
+        for r, neigh in links.items():
+            for m in neigh:
+                assert r in links[m]  # symmetric
+
+    def test_bad_n(self):
+        with pytest.raises(DMLCError):
+            get_ring(0)
+
+
+class TestEnvContract:
+    def test_worker_envs(self):
+        envs = worker_envs("10.0.0.1:9000", 4, 2)
+        assert envs["DMLC_TPU_COORDINATOR_URI"] == "10.0.0.1:9000"
+        assert envs["DMLC_TPU_NUM_WORKER"] == "4"
+        assert envs["DMLC_TPU_TASK_ID"] == "2"
+        # reference names present for downstream compatibility
+        assert envs["DMLC_TRACKER_URI"] == "10.0.0.1"
+        assert envs["DMLC_TRACKER_PORT"] == "9000"
+        assert envs["DMLC_NUM_WORKER"] == "4"
+        assert envs["DMLC_TASK_ID"] == "2"
+        assert envs["DMLC_ROLE"] == "worker"
+
+    def test_find_free_port(self):
+        p = find_free_port()
+        assert 0 < p < 65536
+
+
+class TestLocalLaunch:
+    def test_spawns_workers_with_ranks(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os\n"
+            "rank = os.environ['DMLC_TPU_TASK_ID']\n"
+            "n = os.environ['DMLC_TPU_NUM_WORKER']\n"
+            f"open(r'{tmp_path}' + f'/out-{{rank}}', 'w').write(n)\n")
+        codes = launch_local(3, [sys.executable, str(script)])
+        assert codes == [0, 0, 0]
+        for r in range(3):
+            assert (tmp_path / f"out-{r}").read_text() == "3"
+
+    def test_worker_failure_raises(self, tmp_path):
+        script = tmp_path / "bad.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        with pytest.raises(DMLCError, match="exit codes"):
+            launch_local(2, [sys.executable, str(script)])
+
+    def test_cli_main(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os\n"
+            f"open(r'{tmp_path}/cli-' + os.environ['DMLC_TPU_TASK_ID'], "
+            "'w').close()\n")
+        assert main(["-n", "2", "--", sys.executable, str(script)]) == 0
+        assert (tmp_path / "cli-0").exists() and (tmp_path / "cli-1").exists()
+
+
+class TestSSHLaunch:
+    def test_dry_run_command_lines(self):
+        lines = launch_ssh(["hostA", "hostB"], ["python", "train.py"],
+                           "hostA:9000", num_workers=4, dry_run=True)
+        assert len(lines) == 4
+        assert "hostA" in lines[0] and "hostB" in lines[1]
+        assert "DMLC_TPU_TASK_ID=3" in lines[3]
+        assert "python train.py" in lines[0]
+
+
+class TestLaunchRegressions:
+    def test_bad_coordinator_raises_clearly(self):
+        with pytest.raises(DMLCError, match="host:port"):
+            worker_envs("justahost", 2, 0)
+
+    def test_timeout_kills_all_workers(self, tmp_path):
+        script = tmp_path / "hang.py"
+        script.write_text("import time, os\n"
+                          f"open(r'{tmp_path}/pid-' + "
+                          "os.environ['DMLC_TPU_TASK_ID'], 'w')"
+                          ".write(str(os.getpid()))\n"
+                          "time.sleep(60)\n")
+        import time
+        t0 = time.monotonic()
+        with pytest.raises(DMLCError, match="timeout"):
+            launch_local(3, [sys.executable, str(script)], timeout=2)
+        assert time.monotonic() - t0 < 20  # deadline shared, not 3x
+        time.sleep(0.2)
+        for r in range(3):
+            pid_file = tmp_path / f"pid-{r}"
+            if pid_file.exists():
+                pid = int(pid_file.read_text())
+                with pytest.raises(OSError):
+                    os.kill(pid, 0)  # process must be gone

@@ -1,0 +1,205 @@
+"""Native C++ engine: byte-parity with the Python golden
+(the BASELINE "CSR byte-identical" criterion), shard parity, error
+propagation, float-parse contract."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.data.parser import Parser
+from dmlc_tpu.data.rowblock import RowBlockContainer
+from dmlc_tpu.utils.logging import DMLCError
+
+
+def _ensure_native() -> bool:
+    from dmlc_tpu import native
+    if native.native_available():
+        return True
+    try:
+        subprocess.run([sys.executable, "-m", "dmlc_tpu.native.build"],
+                       check=True, capture_output=True, timeout=300)
+    except Exception:
+        return False
+    native._tried = False  # re-probe after build
+    return native.native_available()
+
+
+pytestmark = pytest.mark.skipif(not _ensure_native(),
+                                reason="native engine not buildable")
+
+
+def parse_all(uri, engine, k=0, n=1, fmt="libsvm", **kw):
+    c = RowBlockContainer(np.uint32)
+    p = Parser.create(uri, k, n, format=fmt, engine=engine, **kw)
+    for b in p:
+        c.push_block(b)
+    if hasattr(p, "destroy"):
+        p.destroy()
+    return c.get_block()
+
+
+@pytest.fixture
+def libsvm_file(tmp_path, rng):
+    lines = []
+    for i in range(800):
+        nnz = rng.randint(0, 15)
+        idx = np.sort(rng.choice(2000, nnz, replace=False))
+        feats = " ".join(f"{j}:{rng.rand():.9g}" for j in idx)
+        qid = f"qid:{i // 10} " if i % 3 == 0 else ""
+        lines.append(f"{(-1) ** i} {qid}{feats}".rstrip())
+    p = tmp_path / "t.libsvm"
+    p.write_bytes(("\n".join(lines) + "\n").encode())
+    return str(p)
+
+
+class TestEngineParity:
+    def test_libsvm_whole(self, libsvm_file):
+        g = parse_all(libsvm_file, "python")
+        n = parse_all(libsvm_file, "native")
+        assert g.content_hash() == n.content_hash()
+
+    @pytest.mark.parametrize("nparts", [2, 3, 5])
+    def test_libsvm_sharded(self, libsvm_file, nparts):
+        g = parse_all(libsvm_file, "python")
+        c = RowBlockContainer(np.uint32)
+        for k in range(nparts):
+            c.push_block(parse_all(libsvm_file, "native", k, nparts))
+        assert c.get_block().content_hash() == g.content_hash()
+
+    def test_csv_parity(self, tmp_path, rng):
+        rows = [",".join(f"{rng.randn():.7g}" for _ in range(8))
+                for _ in range(500)]
+        p = tmp_path / "d.csv"
+        p.write_bytes(("\n".join(rows) + "\n").encode())
+        g = parse_all(str(p), "python", fmt="csv", label_column=0)
+        n = parse_all(str(p), "native", fmt="csv", label_column=0)
+        assert g.content_hash() == n.content_hash()
+
+    def test_csv_weight_column(self, tmp_path):
+        p = tmp_path / "w.csv"
+        p.write_bytes(b"1,0.5,9\n0,2.0,8\n")
+        g = parse_all(str(p), "python", fmt="csv", label_column=0,
+                      weight_column=1)
+        n = parse_all(str(p), "native", fmt="csv", label_column=0,
+                      weight_column=1)
+        assert g.content_hash() == n.content_hash()
+
+    def test_libfm_parity(self, tmp_path, rng):
+        lines = []
+        for i in range(300):
+            nnz = rng.randint(1, 8)
+            toks = " ".join(
+                f"{rng.randint(0, 5)}:{rng.randint(0, 100)}:{rng.rand():.6g}"
+                for _ in range(nnz))
+            lines.append(f"{i % 2} {toks}")
+        p = tmp_path / "x.libfm"
+        p.write_bytes(("\n".join(lines) + "\n").encode())
+        g = parse_all(str(p), "python", fmt="libfm")
+        n = parse_all(str(p), "native", fmt="libfm")
+        assert g.content_hash() == n.content_hash()
+
+    def test_crlf_parity(self, tmp_path):
+        p = tmp_path / "c.libsvm"
+        p.write_bytes(b"1 1:2.5\r\n0 2:1.5\r\n\r\n1 3:0.25\r\n")
+        g = parse_all(str(p), "python")
+        n = parse_all(str(p), "native")
+        assert g.content_hash() == n.content_hash()
+
+    def test_multi_file_parity(self, tmp_path, rng):
+        paths = []
+        for f in range(3):
+            lines = [f"{i % 2} {rng.randint(1, 99)}:{rng.rand():.5g}"
+                     for i in range(rng.randint(5, 50))]
+            p = tmp_path / f"f{f}.libsvm"
+            p.write_bytes(("\n".join(lines) + "\n").encode())
+            paths.append(str(p))
+        uri = ";".join(paths)
+        g = parse_all(uri, "python")
+        n = parse_all(uri, "native")
+        assert g.content_hash() == n.content_hash()
+        c = RowBlockContainer(np.uint32)
+        for k in range(4):
+            c.push_block(parse_all(uri, "native", k, 4))
+        assert c.get_block().content_hash() == g.content_hash()
+
+    def test_indexing_mode_parity(self, tmp_path):
+        p = tmp_path / "i.libsvm"
+        p.write_bytes(b"1 1:2.0 5:3.0\n0 2:1.0\n")
+        for mode in (0, 1, -1):
+            g = parse_all(str(p), "python", indexing_mode=mode)
+            n = parse_all(str(p), "native", indexing_mode=mode)
+            assert g.content_hash() == n.content_hash(), f"mode={mode}"
+
+
+class TestNativeErrors:
+    def test_bad_token_raises(self, tmp_path):
+        p = tmp_path / "bad.libsvm"
+        p.write_bytes(b"1 1:2.0\n1 nonsense\n")
+        with pytest.raises(DMLCError, match="nonsense"):
+            parse_all(str(p), "native")
+
+    def test_bad_label_raises(self, tmp_path):
+        p = tmp_path / "bad2.libsvm"
+        p.write_bytes(b"abc 1:2.0\n")
+        with pytest.raises(DMLCError, match="label"):
+            parse_all(str(p), "native")
+
+    def test_ragged_csv_raises(self, tmp_path):
+        p = tmp_path / "r.csv"
+        p.write_bytes(b"1,2,3\n4,5\n")
+        with pytest.raises(DMLCError, match="column"):
+            parse_all(str(p), "native", fmt="csv")
+
+    def test_zero_index_mode1_raises(self, tmp_path):
+        p = tmp_path / "z.libsvm"
+        p.write_bytes(b"1 0:1.0\n")
+        with pytest.raises(DMLCError, match="indexing_mode"):
+            parse_all(str(p), "native", indexing_mode=1)
+
+    def test_recovers_after_before_first(self, tmp_path):
+        p = tmp_path / "ok.libsvm"
+        p.write_bytes(b"1 1:2.0\n0 2:3.0\n")
+        parser = Parser.create(str(p), 0, 1, format="libsvm",
+                               engine="native")
+        b1 = [b.content_hash() for b in parser]
+        b2 = [b.content_hash() for b in parser]  # before_first replay
+        assert b1 == b2
+        parser.destroy()
+
+
+class TestFloatParseContract:
+    def test_adversarial_decimals(self, rng):
+        from dmlc_tpu.native.bindings import native_parse_float32
+        from dmlc_tpu.data.strtonum import parse_float32
+        tokens = [b"1.5", b"-0.0", b"0.1", b"1e-45", b"3.4028235e38",
+                  b"1.17549435e-38", b"2.2250738585072014e-308",
+                  b"9007199254740993", b"0.30000000000000004",
+                  b"1.0000000000000002", b".5", b"5.", b"1e-400", b"123456789.123456789",
+                  b"4.9406564584124654e-324", b"1.7976931348623157e308"]
+        for _ in range(500):
+            mantissa = rng.randint(0, 10 ** rng.randint(1, 18))
+            exp = rng.randint(-40, 40)
+            tokens.append(f"{mantissa}e{exp}".encode())
+            tokens.append(f"{mantissa / 10**rng.randint(0, 17):.17g}".encode())
+        for t in tokens:
+            try:
+                golden = parse_float32(t)
+            except (ValueError, OverflowError):
+                # Python float() raises on overflow for e.g. 1e400? (no,
+                # returns inf); keep symmetric anyway
+                with pytest.raises(ValueError):
+                    native_parse_float32(t)
+                continue
+            got = native_parse_float32(t)
+            assert np.float32(golden).tobytes() == np.float32(got).tobytes(), t
+
+    def test_underscore_rejected_both(self):
+        from dmlc_tpu.native.bindings import native_parse_float32
+        from dmlc_tpu.data.strtonum import parse_float32
+        with pytest.raises(ValueError):
+            parse_float32(b"1_0")
+        with pytest.raises(ValueError):
+            native_parse_float32(b"1_0")
