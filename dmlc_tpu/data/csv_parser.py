@@ -71,12 +71,5 @@ class CSVParser(TextParserBase):
 
 @PARSER_REGISTRY.register("csv", description="dense csv text")
 def _make_csv(**kwargs):
-    engine = kwargs.get("engine", "auto")
-    if engine in ("auto", "native"):
-        from dmlc_tpu.native import native_available
-        if native_available():
-            from dmlc_tpu.native.bindings import NativeCSVParser
-            return NativeCSVParser(**kwargs)
-        if engine == "native":
-            raise DMLCError("native engine requested but not built")
-    return CSVParser(**kwargs)
+    from dmlc_tpu.data.parser import native_or
+    return native_or("NativeCSVParser", CSVParser, kwargs)

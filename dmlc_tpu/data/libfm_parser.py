@@ -70,4 +70,5 @@ class LibFMParser(TextParserBase):
 
 @PARSER_REGISTRY.register("libfm", description="label field:idx:val text")
 def _make_libfm(**kwargs):
-    return LibFMParser(**kwargs)
+    from dmlc_tpu.data.parser import native_or
+    return native_or("NativeLibFMParser", LibFMParser, kwargs)

@@ -78,12 +78,5 @@ class LibSVMParser(TextParserBase):
 
 @PARSER_REGISTRY.register("libsvm", description="label idx:val sparse text")
 def _make_libsvm(**kwargs):
-    engine = kwargs.get("engine", "auto")
-    if engine in ("auto", "native"):
-        from dmlc_tpu.native import native_available
-        if native_available():
-            from dmlc_tpu.native.bindings import NativeLibSVMParser
-            return NativeLibSVMParser(**kwargs)
-        if engine == "native":
-            raise DMLCError("native engine requested but not built")
-    return LibSVMParser(**kwargs)
+    from dmlc_tpu.data.parser import native_or
+    return native_or("NativeLibSVMParser", LibSVMParser, kwargs)

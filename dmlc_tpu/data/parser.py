@@ -26,9 +26,33 @@ from dmlc_tpu.io.uri_spec import URISpec
 from dmlc_tpu.utils.logging import DMLCError, check
 from dmlc_tpu.utils.registry import Registry
 
-__all__ = ["DataIter", "Parser", "TextParserBase", "PARSER_REGISTRY"]
+__all__ = ["DataIter", "Parser", "TextParserBase", "PARSER_REGISTRY",
+           "native_or"]
 
 PARSER_REGISTRY = Registry.get("ParserFactory")
+
+
+def native_or(native_cls_name: str, python_cls, kwargs):
+    """Shared engine dispatch for text-format factories.
+
+    engine="auto": prefer the built native engine, fall back to the
+    Python golden for URIs it cannot serve (stdin, '#cache', remote
+    schemes). engine="native": require it, re-raising any failure.
+    engine="python": golden only.
+    """
+    engine = kwargs.get("engine", "auto")
+    if engine in ("auto", "native"):
+        from dmlc_tpu.native import native_available
+        if native_available():
+            try:
+                from dmlc_tpu.native import bindings
+                return getattr(bindings, native_cls_name)(**kwargs)
+            except (DMLCError, FileNotFoundError, OSError):
+                if engine == "native":
+                    raise
+        elif engine == "native":
+            raise DMLCError("native engine requested but not built")
+    return python_cls(**kwargs)
 
 
 class DataIter:
@@ -98,7 +122,7 @@ class TextParserBase(Parser):
                                         split_type, chunk_size=chunk_size)
         self._block: Optional[RowBlock] = None
         self._prefetch: Optional[ThreadedIter] = None
-        if prefetch:
+        if prefetch and getattr(self._split, "rewindable", True):
             self._prefetch = ThreadedIter(max_capacity=4)
             self._prefetch.init(self._split.next_chunk,
                                 self._split.before_first)

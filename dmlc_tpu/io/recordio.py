@@ -93,6 +93,40 @@ class RecordIOWriter:
             s.write(b"\x00" * pad)
 
 
+class IndexedRecordIOWriter(RecordIOWriter):
+    """RecordIO writer that also maintains a key→offset index.
+
+    Reference: the ``key\\toffset`` index files consumed by
+    src/io/indexed_recordio_split.cc (upstream generates them with
+    MXNet-side tooling; here the writer produces them directly).
+    The stream must be fresh (offsets count from its current position 0).
+    """
+
+    class _CountingStream:
+        def __init__(self, inner: Stream):
+            self.inner = inner
+            self.written = 0
+
+        def write(self, data) -> int:
+            n = self.inner.write(data)
+            self.written += len(data)
+            return n
+
+    def __init__(self, stream: Stream, index_stream: Stream):
+        self._counter = self._CountingStream(stream)
+        super().__init__(self._counter)
+        self._index_stream = index_stream
+        self._auto_key = 0
+
+    def write_record(self, data, key: Optional[int] = None) -> None:
+        if key is None:
+            key = self._auto_key
+            self._auto_key += 1
+        self._index_stream.write(
+            f"{key}\t{self._counter.written}\n".encode())
+        super().write_record(data)
+
+
 class RecordIOReader:
     """Reference: RecordIOReader (src/recordio.cc)."""
 

@@ -1,0 +1,76 @@
+"""Reusable host-buffer pools.
+
+Reference: include/dmlc/memory.h — MemoryPool (size-classed),
+ThreadlocalAllocator/ThreadlocalSharedPtr. The TPU-relevant re-design:
+what gets recycled here are the pinned host numpy staging buffers that
+feed jax.device_put — allocation churn on the host→HBM edge is the
+analogue of the reference's free-list concern.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from dmlc_tpu.utils.logging import check
+
+__all__ = ["BufferPool", "thread_local_pool"]
+
+
+class BufferPool:
+    """Size-classed pool of reusable numpy buffers (reference: MemoryPool).
+
+    acquire() rounds the request up to the next power of two and reuses a
+    released buffer of that class when available; release() returns it.
+    Buffers are 1-D uint8; view/reshape at the call site.
+    """
+
+    def __init__(self, max_buffers_per_class: int = 8):
+        self._lock = threading.Lock()
+        self._free: Dict[int, List[np.ndarray]] = {}
+        self._max_per_class = max_buffers_per_class
+        self.allocated = 0
+        self.reused = 0
+
+    @staticmethod
+    def _size_class(nbytes: int) -> int:
+        c = 256
+        while c < nbytes:
+            c <<= 1
+        return c
+
+    def acquire(self, nbytes: int) -> np.ndarray:
+        check(nbytes >= 0, "negative buffer size")
+        cls = self._size_class(nbytes)
+        with self._lock:
+            bucket = self._free.get(cls)
+            if bucket:
+                self.reused += 1
+                return bucket.pop()
+        self.allocated += 1
+        return np.empty(cls, np.uint8)
+
+    def release(self, buf: np.ndarray) -> None:
+        cls = self._size_class(len(buf))
+        if len(buf) != cls:
+            return  # a view or foreign buffer, not one of ours: drop it
+        with self._lock:
+            bucket = self._free.setdefault(cls, [])
+            if len(bucket) < self._max_per_class:
+                bucket.append(buf)
+
+    def stats(self) -> Tuple[int, int]:
+        return self.allocated, self.reused
+
+
+_tls = threading.local()
+
+
+def thread_local_pool() -> BufferPool:
+    """Per-thread pool (reference: ThreadlocalAllocator)."""
+    pool = getattr(_tls, "pool", None)
+    if pool is None:
+        pool = _tls.pool = BufferPool()
+    return pool

@@ -1,0 +1,75 @@
+"""Parquet/Arrow parser (BASELINE config 5; no reference counterpart)."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+from dmlc_tpu.data.parser import Parser  # noqa: E402
+from dmlc_tpu.data.rowblock import RowBlockContainer  # noqa: E402
+
+
+@pytest.fixture
+def parquet_file(tmp_path, rng):
+    n = 1000
+    table = pa.table({
+        "label": rng.randint(0, 2, n).astype(np.float32),
+        "f0": rng.rand(n).astype(np.float32),
+        "f1": rng.randn(n).astype(np.float32),
+        "f2": rng.rand(n).astype(np.float32),
+    })
+    path = str(tmp_path / "d.parquet")
+    pq.write_table(table, path, row_group_size=100)
+    return path, table
+
+
+def drain(parser):
+    c = RowBlockContainer(np.uint32)
+    for b in parser:
+        c.push_block(b)
+    return c.get_block()
+
+
+class TestParquetParser:
+    def test_basic(self, parquet_file):
+        path, table = parquet_file
+        parser = Parser.create(path, 0, 1, format="parquet",
+                               label_column="label")
+        block = drain(parser)
+        assert block.size == 1000
+        np.testing.assert_array_equal(
+            block.label, table.column("label").to_numpy())
+        # dense rows: 3 feature columns in order
+        np.testing.assert_allclose(
+            block.value.reshape(1000, 3)[:, 0],
+            table.column("f0").to_numpy(), rtol=1e-6)
+        assert parser.bytes_read() > 0
+
+    def test_row_group_sharding_coverage(self, parquet_file):
+        path, table = parquet_file
+        whole = drain(Parser.create(path, 0, 1, format="parquet",
+                                    label_column="label"))
+        labels = []
+        for k in range(3):
+            blk = drain(Parser.create(path, k, 3, format="parquet",
+                                      label_column="label"))
+            labels.append(blk.label)
+        got = np.concatenate(labels)
+        assert len(got) == 1000
+        # row groups are whole units: sorting restores equality
+        np.testing.assert_array_equal(np.sort(got), np.sort(whole.label))
+
+    def test_no_label_column(self, parquet_file):
+        path, _ = parquet_file
+        block = drain(Parser.create(path, 0, 1, format="parquet"))
+        assert block.size == 1000
+        np.testing.assert_array_equal(block.label, np.zeros(1000))
+        assert block.value.reshape(1000, 4).shape == (1000, 4)
+
+    def test_uri_args(self, parquet_file):
+        path, table = parquet_file
+        block = drain(Parser.create(
+            path + "?format=parquet&label_column=label"))
+        np.testing.assert_array_equal(
+            block.label, table.column("label").to_numpy())
