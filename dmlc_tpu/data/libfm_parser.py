@@ -11,7 +11,7 @@ import numpy as np
 
 from dmlc_tpu.data.parser import PARSER_REGISTRY, TextParserBase
 from dmlc_tpu.data.rowblock import RowBlockContainer
-from dmlc_tpu.data.strtonum import parse_float32
+from dmlc_tpu.data.strtonum import parse_float32, parse_index, parse_uint64
 from dmlc_tpu.utils.logging import DMLCError
 from dmlc_tpu.utils.parameter import Parameter, field
 
@@ -49,8 +49,8 @@ class LibFMParser(TextParserBase):
                 if len(parts) != 3:
                     raise DMLCError(f"libfm: bad token {t!r} "
                                     "(want field:idx:val)")
-                fields[j] = int(parts[0])
-                idxs[j] = int(parts[1])
+                fields[j] = parse_index(parts[0])
+                idxs[j] = parse_uint64(parts[1])
                 vals[j] = parse_float32(parts[2])
             if n:
                 m = int(idxs.min())

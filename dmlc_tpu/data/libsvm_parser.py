@@ -17,7 +17,7 @@ import numpy as np
 
 from dmlc_tpu.data.parser import PARSER_REGISTRY, TextParserBase
 from dmlc_tpu.data.rowblock import RowBlockContainer
-from dmlc_tpu.data.strtonum import parse_float32
+from dmlc_tpu.data.strtonum import parse_float32, parse_index, parse_uint64
 from dmlc_tpu.utils.logging import DMLCError
 from dmlc_tpu.utils.parameter import Parameter, field
 
@@ -49,7 +49,7 @@ class LibSVMParser(TextParserBase):
             qid = -1
             feats = toks[1:]
             if feats and feats[0].startswith(b"qid:"):
-                qid = int(feats[0][4:])
+                qid = parse_index(feats[0][4:])
                 feats = feats[1:]
             idxs = np.empty(len(feats), np.int64)
             vals = np.empty(len(feats), np.float32)
@@ -57,7 +57,7 @@ class LibSVMParser(TextParserBase):
                 i, sep, v = t.rpartition(b":")
                 if not sep:
                     raise DMLCError(f"libsvm: bad feature token {t!r}")
-                idxs[j] = int(i)
+                idxs[j] = parse_uint64(i)
                 vals[j] = parse_float32(v)
             if len(idxs):
                 m = int(idxs.min())

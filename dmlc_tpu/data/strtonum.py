@@ -21,7 +21,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["parse_float32", "parse_float64", "parse_index", "F32"]
+__all__ = ["parse_float32", "parse_float64", "parse_index", "parse_uint64",
+           "F32"]
 
 F32 = np.float32
 
@@ -43,6 +44,29 @@ def parse_float32(token: bytes) -> np.float32:
     return np.float32(parse_float64(token))
 
 
+def parse_uint64(token: bytes) -> int:
+    """Frozen unsigned-index contract: optional leading '+', ASCII digits
+    only (no '-', no underscores, no whitespace), must fit uint64 —
+    exactly the C++ engine's inline digit scan / from_chars<uint64>."""
+    t = bytes(token)
+    if t[:1] == b"+" and len(t) > 1:
+        t = t[1:]
+    if not t or not t.isdigit():  # bytes.isdigit() is ASCII-only
+        raise ValueError(f"invalid index literal {token!r}")
+    v = int(t)
+    if v > 0xFFFFFFFFFFFFFFFF:
+        raise ValueError(f"index out of uint64 range: {token!r}")
+    return v
+
+
 def parse_index(token: bytes) -> int:
-    """Base-10 integer (reference: ParseSignedIndex)."""
-    return int(token)
+    """Base-10 signed int64 (reference: ParseSignedIndex): optional
+    '+'/'-', ASCII digits only — matches C++ from_chars<int64>."""
+    t = bytes(token)
+    body = t[1:] if t[:1] in (b"+", b"-") and len(t) > 1 else t
+    if not body or not body.isdigit():
+        raise ValueError(f"invalid integer literal {token!r}")
+    v = int(t)
+    if not (-(2 ** 63) <= v < 2 ** 63):
+        raise ValueError(f"integer out of int64 range: {token!r}")
+    return v

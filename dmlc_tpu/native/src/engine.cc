@@ -51,7 +51,7 @@ struct EngineError {
 // Python golden) return ±0 on underflow and ±inf on overflow. The sign of
 // the estimated decimal exponent decides which (ERANGE can only happen at
 // |exp10| >> 0, so the estimate needs no precision).
-inline bool parse_f64(const char* b, const char* e, double* out) {
+bool parse_f64_slow(const char* b, const char* e, double* out) {
   // strtod/Python accept a leading '+'; from_chars does not
   if (b < e && *b == '+' && e - b > 1) ++b;
   auto r = std::from_chars(b, e, *out);
@@ -96,6 +96,68 @@ inline bool parse_f64(const char* b, const char* e, double* out) {
   return false;
 }
 
+// Clinger fast path: a decimal with mantissa ≤ 2^53 and |exp10| ≤ 22 is
+// exactly (double)mant * / 10^|exp10| with ONE rounding, i.e. correctly
+// rounded — identical to from_chars/strtod on that class. Anything outside
+// the class (too many digits, big exponent, inf/nan spellings, hex) falls
+// back to parse_f64_slow. This covers the overwhelmingly common "%g"/"%f"
+// tokens in libsvm/csv data at a fraction of from_chars' cost.
+const double kPow10[23] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10, 1e11,
+    1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+inline bool parse_f64(const char* b, const char* e, double* out) {
+  const char* p = b;
+  if (p < e && (*p == '+' || *p == '-')) ++p;
+  bool neg = (b < e && *b == '-');
+  uint64_t mant = 0;
+  int exp10 = 0;
+  bool any_digit = false, seen_point = false, overflow = false;
+  for (; p < e; ++p) {
+    unsigned d = (unsigned)(*p - '0');
+    if (d <= 9) {
+      any_digit = true;
+      if (mant > ((UINT64_MAX - 9) / 10)) { overflow = true; break; }
+      mant = mant * 10 + d;
+      if (seen_point) --exp10;
+      continue;
+    }
+    if (*p == '.') {
+      if (seen_point) return false;
+      seen_point = true;
+      continue;
+    }
+    break;
+  }
+  if (!overflow && any_digit && p < e && (*p == 'e' || *p == 'E')) {
+    ++p;
+    bool eneg = false;
+    if (p < e && (*p == '+' || *p == '-')) { eneg = (*p == '-'); ++p; }
+    if (p >= e) return false;
+    long ev = 0;
+    for (; p < e; ++p) {
+      unsigned d = (unsigned)(*p - '0');
+      if (d > 9) break;
+      if (ev < 100000) ev = ev * 10 + (long)d;
+    }
+    exp10 += (int)(eneg ? -ev : ev);
+  }
+  if (!overflow && p == e && any_digit) {
+    if (mant == 0) {
+      *out = neg ? -0.0 : 0.0;
+      return true;
+    }
+    if (mant <= (1ULL << 53) && exp10 >= -22 && exp10 <= 22) {
+      double d = (double)mant;
+      if (exp10 > 0) d *= kPow10[exp10];
+      else if (exp10 < 0) d /= kPow10[-exp10];
+      *out = neg ? -d : d;
+      return true;
+    }
+  }
+  return parse_f64_slow(b, e, out);
+}
+
 inline bool parse_f32(const char* b, const char* e, float* out) {
   double d;
   if (!parse_f64(b, e, &d)) return false;
@@ -105,8 +167,16 @@ inline bool parse_f32(const char* b, const char* e, float* out) {
 
 inline bool parse_u64(const char* b, const char* e, uint64_t* out) {
   if (b < e && *b == '+' && e - b > 1) ++b;
-  auto r = std::from_chars(b, e, *out);
-  return r.ec == std::errc() && r.ptr == e;
+  if (b >= e) return false;
+  uint64_t v = 0;
+  for (const char* p = b; p < e; ++p) {
+    unsigned d = (unsigned)(*p - '0');
+    if (d > 9) return false;
+    if (v > (UINT64_MAX - d) / 10) return false;
+    v = v * 10 + d;
+  }
+  *out = v;
+  return true;
 }
 
 inline bool parse_i64(const char* b, const char* e, int64_t* out) {
@@ -130,9 +200,22 @@ struct CSRArena {
   std::vector<int64_t> field;
   bool has_weight = false, has_qid = false, has_field = false;
   uint64_t min_index = UINT64_MAX;
+  uint64_t max_index = 0;
 
   size_t rows() const { return label.size(); }
   size_t nnz() const { return index.size(); }
+
+  // reset content, keep vector capacity (arenas are pooled across chunks
+  // to avoid large-allocation mmap/munmap + page-fault churn per chunk)
+  void clear() {
+    offset.clear();
+    offset.push_back(0);
+    label.clear(); weight.clear(); qid.clear();
+    index.clear(); value.clear(); field.clear();
+    has_weight = has_qid = has_field = false;
+    min_index = UINT64_MAX;
+    max_index = 0;
+  }
 
   void append(CSRArena&& o) {
     int64_t base = offset.back();
@@ -146,6 +229,7 @@ struct CSRArena {
     cat(index, o.index); cat(value, o.value); cat(field, o.field);
     has_weight |= o.has_weight; has_qid |= o.has_qid; has_field |= o.has_field;
     min_index = std::min(min_index, o.min_index);
+    max_index = std::max(max_index, o.max_index);
   }
 };
 
@@ -195,17 +279,24 @@ class TextShardReader {
       if (!fp_ && cur_ < end_) OpenAt(cur_);
       int64_t want = std::min<int64_t>(
           chunk_bytes_, std::min(file_end_ - cur_, end_ - cur_));
-      std::string raw(want > 0 ? want : 0, '\0');
-      if (want > 0) {
-        size_t got = fread(raw.data(), 1, (size_t)want, fp_);
-        raw.resize(got);
-      }
-      bytes_read_ += (int64_t)raw.size();
-      cur_ += (int64_t)raw.size();
-      bool at_file_end = cur_ >= std::min(file_end_, end_);
-      std::string combined = leftover_.empty() ? std::move(raw)
-                                               : leftover_ + raw;
+      // read directly after the carried partial record — no concat copy
+      std::string combined = std::move(leftover_);
       leftover_.clear();
+      size_t head = combined.size();
+      if (want > 0) {
+        combined.resize(head + (size_t)want);
+        size_t got = fread(combined.data() + head, 1, (size_t)want, fp_);
+        combined.resize(head + got);
+        bytes_read_ += (int64_t)got;
+        cur_ += (int64_t)got;
+        // the VFS listing promised more bytes: a zero read here means the
+        // file shrank or errored — fail instead of spinning forever
+        if (got == 0)
+          throw EngineError{
+              "short read: file truncated or unreadable at global offset " +
+              std::to_string(cur_)};
+      }
+      bool at_file_end = cur_ >= std::min(file_end_, end_);
       if (at_file_end) {
         CloseFile();
         if (cur_ >= end_) cur_ = end_;
@@ -333,36 +424,55 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
     q = tok_end;
     size_t row_nnz = 0;
     bool seen_feature = false;
+    // Feature tokens parse index digits in the same pass as the token
+    // scan. Note this splits at the FIRST colon while the reference
+    // splits at the LAST — equivalent, because the index is all-digits:
+    // every token with 2+ colons is an error under both rules (last-colon
+    // makes the index invalid; first-colon makes the value invalid).
     while (true) {
       while (q < line_end && is_ws(*q)) ++q;
       if (q >= line_end) break;
-      tok_end = q;
-      while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
-      // qid: only directly after the label (golden parity)
-      if (!seen_feature && tok_end - q > 4 &&
-          std::memcmp(q, "qid:", 4) == 0) {
-        if (!parse_i64(q + 4, tok_end, &qid))
-          throw EngineError{"libsvm: bad qid token '" +
-                            std::string(q, tok_end) + "'"};
-        a->has_qid = true;
-        q = tok_end;
-        continue;
+      const char* s = q;
+      if (s < line_end && *s == '+') ++s;  // golden contract allows '+'
+      const char* dstart = s;
+      uint64_t idx = 0;
+      while (s < line_end) {
+        unsigned d = (unsigned)(*s - '0');
+        if (d > 9) break;
+        if (idx > (UINT64_MAX - d) / 10) { s = dstart; break; }  // overflow
+        idx = idx * 10 + d;
+        ++s;
       }
-      const char* colon = tok_end;
-      for (const char* c = tok_end - 1; c > q; --c)
-        if (*c == ':') { colon = c; break; }
-      uint64_t idx;
-      float val;
-      if (colon == tok_end || !parse_u64(q, colon, &idx) ||
-          !parse_f32(colon + 1, tok_end, &val))
+      if (s == dstart || s >= line_end || *s != ':') {
+        // not "digits:..." — qid token (only directly after the label,
+        // golden parity) or malformed
+        tok_end = s;
+        while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
+        if (!seen_feature && tok_end - q > 4 &&
+            std::memcmp(q, "qid:", 4) == 0) {
+          if (!parse_i64(q + 4, tok_end, &qid))
+            throw EngineError{"libsvm: bad qid token '" +
+                              std::string(q, tok_end) + "'"};
+          a->has_qid = true;
+          q = tok_end;
+          continue;
+        }
         throw EngineError{"libsvm: bad feature token '" +
                           std::string(q, tok_end) + "'"};
+      }
+      const char* vb = ++s;
+      while (s < line_end && !is_ws(*s)) ++s;
+      float val;
+      if (!parse_f32(vb, s, &val))
+        throw EngineError{"libsvm: bad feature token '" +
+                          std::string(q, s) + "'"};
       a->index.push_back(idx);
       a->value.push_back(val);
       a->min_index = std::min(a->min_index, idx);
+      a->max_index = std::max(a->max_index, idx);
       ++row_nnz;
       seen_feature = true;
-      q = tok_end;
+      q = s;
     }
     a->label.push_back(label);
     a->weight.push_back(1.0f);
@@ -425,7 +535,10 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
                         std::to_string(col) + " vs " + std::to_string(expect) +
                         ")"};
     if (cfg.weight_column >= 0) a->has_weight = true;
-    if (row_nnz) a->min_index = 0;
+    if (row_nnz) {
+      a->min_index = 0;
+      a->max_index = std::max(a->max_index, (uint64_t)(fidx - 1));
+    }
     a->label.push_back(label);
     a->weight.push_back(weight);
     a->qid.push_back(-1);
@@ -470,6 +583,7 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
       a->index.push_back(idx);
       a->value.push_back(val);
       a->min_index = std::min(a->min_index, idx);
+      a->max_index = std::max(a->max_index, idx);
       ++row_nnz;
       q = tok_end;
     }
@@ -483,9 +597,10 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
 
 // Split a chunk at record boundaries into ~nslices and parse in the
 // calling thread pool slot; slices stitched in order (reference:
-// TextParserBase OpenMP ParseBlock + FillData stitch).
-CSRArena ParseChunk(const std::string& chunk, const ParserConfig& cfg,
-                    std::atomic<long>* ncol_atom, int nslices) {
+// TextParserBase OpenMP ParseBlock + FillData stitch). Slice 0 parses
+// directly into *out (typically a pooled arena with warm capacity).
+void ParseChunk(const std::string& chunk, const ParserConfig& cfg,
+                std::atomic<long>* ncol_atom, int nslices, CSRArena* out) {
   const char* b = chunk.data();
   const char* e = b + chunk.size();
   std::vector<std::pair<const char*, const char*>> slices;
@@ -504,21 +619,22 @@ CSRArena ParseChunk(const std::string& chunk, const ParserConfig& cfg,
     }
     if (s < e) slices.emplace_back(s, e);
   }
-  std::vector<CSRArena> parts(slices.size());
+  std::vector<CSRArena> parts(slices.size() > 1 ? slices.size() - 1 : 0);
   std::vector<std::string> errors(slices.size());
   std::vector<std::thread> threads;
   auto work = [&](size_t i) {
+    CSRArena* dst = (i == 0) ? out : &parts[i - 1];
     try {
       switch (cfg.format) {
         case Format::kLibSVM:
-          ParseLibSVMSlice(slices[i].first, slices[i].second, &parts[i]);
+          ParseLibSVMSlice(slices[i].first, slices[i].second, dst);
           break;
         case Format::kCSV:
           ParseCSVSlice(slices[i].first, slices[i].second, cfg, ncol_atom,
-                        &parts[i]);
+                        dst);
           break;
         case Format::kLibFM:
-          ParseLibFMSlice(slices[i].first, slices[i].second, &parts[i]);
+          ParseLibFMSlice(slices[i].first, slices[i].second, dst);
           break;
       }
     } catch (const EngineError& err) {
@@ -535,9 +651,7 @@ CSRArena ParseChunk(const std::string& chunk, const ParserConfig& cfg,
   }
   for (auto& err : errors)
     if (!err.empty()) throw EngineError{err};
-  CSRArena out = std::move(parts[0]);
-  for (size_t i = 1; i < parts.size(); ++i) out.append(std::move(parts[i]));
-  return out;
+  for (auto& part : parts) out->append(std::move(part));
 }
 
 // ------------------------------------------------------------- pipeline
@@ -615,6 +729,31 @@ struct ParserHandle {
   bool mode_resolved = false;
   std::string error;
 
+  // arena free-list shared between the worker (producer) and Next()
+  // (consumer recycles the previous current block) — bounds live arenas
+  // to queue capacity + pool without per-chunk large malloc/free
+  std::mutex pool_mu;
+  std::vector<std::unique_ptr<CSRArena>> arena_pool;
+
+  std::unique_ptr<CSRArena> GetArena() {
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      if (!arena_pool.empty()) {
+        auto a = std::move(arena_pool.back());
+        arena_pool.pop_back();
+        a->clear();
+        return a;
+      }
+    }
+    return std::make_unique<CSRArena>();
+  }
+
+  void RecycleArena(std::unique_ptr<CSRArena> a) {
+    if (!a) return;
+    std::lock_guard<std::mutex> lk(pool_mu);
+    arena_pool.push_back(std::move(a));
+  }
+
   ~ParserHandle() { StopPipeline(); }
 
   void StopPipeline() {
@@ -633,8 +772,8 @@ struct ParserHandle {
       try {
         std::string chunk;
         while (reader->NextChunk(&chunk)) {
-          auto arena = std::make_unique<CSRArena>(
-              ParseChunk(chunk, cfg, &ncol, nthreads));
+          auto arena = GetArena();
+          ParseChunk(chunk, cfg, &ncol, nthreads, arena.get());
           if (!blocks->Push({std::move(arena), std::string()})) return;
         }
         blocks->Finish();
@@ -651,6 +790,7 @@ struct ParserHandle {
   // returns rows; 0 = end; -1 = error (message in this->error)
   int64_t Next() {
     if (!blocks) StartPipeline();
+    RecycleArena(std::move(current));  // consumer is done with it
     std::pair<std::unique_ptr<CSRArena>, std::string> item;
     while (blocks->Pop(&item)) {
       if (!item.first) {
@@ -672,8 +812,15 @@ struct ParserHandle {
           return -1;
         }
         for (auto& ix : a->index) ix -= 1;
+        if (a->nnz()) {
+          a->min_index -= 1;
+          a->max_index -= 1;
+        }
       }
-      if (a->rows() == 0) continue;  // skip empty blocks
+      if (a->rows() == 0) {  // skip empty blocks
+        RecycleArena(std::move(a));
+        continue;
+      }
       current = std::move(a);
       return (int64_t)current->rows();
     }
@@ -752,10 +899,9 @@ int64_t dtp_parser_next(void* handle, const int64_t** offset,
   *value = a->value.data();
   *field = a->has_field ? a->field.data() : nullptr;
   *nnz = (int64_t)a->nnz();
-  // narrow index to u32 when it fits (the default RowBlock dtype)
-  bool fits32 = true;
-  for (uint64_t ix : a->index)
-    if (ix > UINT32_MAX) { fits32 = false; break; }
+  // narrow index to u32 when it fits (the default RowBlock dtype);
+  // max_index is tracked during parse so this is O(1)
+  bool fits32 = a->max_index <= UINT32_MAX;
   if (fits32) {
     h->index32.resize(a->index.size());
     for (size_t i = 0; i < a->index.size(); ++i)

@@ -203,3 +203,65 @@ class TestFloatParseContract:
             parse_float32(b"1_0")
         with pytest.raises(ValueError):
             native_parse_float32(b"1_0")
+
+
+class TestIndexContract:
+    """Frozen index semantics: optional '+', ASCII digits only — identical
+    across engines (regression: the engines used to diverge on '+3:v' and
+    Python's int() accepted '-'/'_' forms the native engine rejects)."""
+
+    def test_plus_prefixed_index_parity(self, tmp_path):
+        p = tmp_path / "plus.libsvm"
+        p.write_bytes(b"1 +3:0.5 7:1.25\n0 +0:0.75\n")
+        g = parse_all(str(p), "python")
+        n = parse_all(str(p), "native")
+        assert g.content_hash() == n.content_hash()
+        assert g.index.tolist() == [3, 7, 0]
+
+    @pytest.mark.parametrize("tok", [b"-3:1.0", b"1_0:1.0", b"+:1.0"])
+    def test_bad_index_rejected_by_both(self, tmp_path, tok):
+        p = tmp_path / "badidx.libsvm"
+        p.write_bytes(b"1 " + tok + b"\n")
+        with pytest.raises(Exception):
+            parse_all(str(p), "python")
+        with pytest.raises(DMLCError):
+            parse_all(str(p), "native")
+
+    def test_strict_uint64_contract(self):
+        from dmlc_tpu.data.strtonum import parse_index, parse_uint64
+        assert parse_uint64(b"+3") == 3
+        assert parse_uint64(b"0") == 0
+        assert parse_uint64(str(2 ** 64 - 1).encode()) == 2 ** 64 - 1
+        for bad in (b"", b"+", b"-1", b"1_0", b" 1", b"1 ", str(2 ** 64).encode()):
+            with pytest.raises(ValueError):
+                parse_uint64(bad)
+        assert parse_index(b"-5") == -5
+        with pytest.raises(ValueError):
+            parse_index(b"1_0")
+
+
+class TestTruncatedFile:
+    def test_short_read_raises_not_hangs(self, tmp_path):
+        """File shrinking between size listing and read must error, not
+        spin the reader thread forever (regression)."""
+        import ctypes as C
+
+        from dmlc_tpu.native import get_lib
+        lib = get_lib()
+        p = tmp_path / "trunc.libsvm"
+        p.write_bytes(b"1 1:2.0\n")
+        paths = (C.c_char_p * 1)(str(p).encode())
+        sizes = (C.c_int64 * 1)(10_000)  # lie: promise more bytes
+        h = lib.dtp_parser_create(paths, sizes, 1, 0, 1, b"libsvm", 1,
+                                  1 << 20, 0, -1, -1, b",")
+        assert h
+        from dmlc_tpu.native.bindings import NativeLibSVMParser
+        parser = NativeLibSVMParser.__new__(NativeLibSVMParser)
+        parser._lib = lib
+        parser._handle = h
+        parser._block = None
+        parser.index_dtype = np.dtype(np.uint32)
+        with pytest.raises(DMLCError, match="short read|truncated"):
+            while parser.next():
+                pass
+        parser.destroy()
