@@ -1,0 +1,287 @@
+"""BASELINE.json benchmark suite — all five configs.
+
+The reference publishes no numbers (BASELINE.md); its only measurement
+hook is the throughput printout in the manual program
+``test/dataiter_test.cc``. This module is that harness rebuilt for the
+TPU framework: every config emits one JSON line with GB/s, bytes read,
+rows/records parsed, and a CSR content hash for the byte-parity check.
+
+Configs (BASELINE.json order):
+  1. libsvm  — LibSVMParser → RowBlockIter on an a1a-shaped single file
+  2. csv     — CSVParser dense RowBlock on a HIGGS-shaped file (28 cols)
+  3. recordio— RecordIO InputSplit reader, multi-part (.rec files)
+  4. prefetch— ThreadedIter-prefetch parse over multi-host InputSplit
+               shards (every part_index parsed, coverage verified), plus
+               device transfer when an accelerator is present
+  5. parquet — Parquet/Arrow columnar ingest (pyarrow boundary)
+
+Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_TMP = "/tmp/dmlc_tpu_bench_suite"
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _emit(payload: Dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def _content_hash(uri: str, fmt: str, **kw) -> str:
+    from dmlc_tpu.data.parser import Parser
+    from dmlc_tpu.data.rowblock import RowBlockContainer
+    c = RowBlockContainer(np.uint32)
+    p = Parser.create(uri, 0, 1, format=fmt, **kw)
+    for b in p:
+        c.push_block(b)
+    if hasattr(p, "destroy"):
+        p.destroy()
+    return c.get_block().content_hash()
+
+
+# ------------------------------------------------------------ data makers
+
+def make_libsvm(path: str, mb: int, seed: int = 0) -> int:
+    """a1a-shaped: ±1 labels, sparse binary-ish features, small index
+    space (a1a has 123 features; values 1)."""
+    if os.path.exists(path) and os.path.getsize(path) >= (mb << 20) * 3 // 4:
+        return os.path.getsize(path)
+    rng = np.random.RandomState(seed)
+    rows = []
+    for i in range(4000):
+        nnz = rng.randint(8, 18)
+        idx = np.sort(rng.choice(123, nnz, replace=False))
+        rows.append(f"{(-1) ** i} " + " ".join(f"{j}:1" for j in idx))
+    block = ("\n".join(rows) + "\n").encode()
+    with open(path, "wb") as f:
+        for _ in range(max(1, (mb << 20) // len(block))):
+            f.write(block)
+    return os.path.getsize(path)
+
+
+def make_csv(path: str, mb: int, seed: int = 0) -> int:
+    """HIGGS-shaped: label + 28 float columns."""
+    if os.path.exists(path) and os.path.getsize(path) >= (mb << 20) * 3 // 4:
+        return os.path.getsize(path)
+    rng = np.random.RandomState(seed)
+    rows = []
+    for i in range(2000):
+        vals = rng.rand(28)
+        rows.append(f"{i % 2}," + ",".join(f"{v:.6f}" for v in vals))
+    block = ("\n".join(rows) + "\n").encode()
+    with open(path, "wb") as f:
+        for _ in range(max(1, (mb << 20) // len(block))):
+            f.write(block)
+    return os.path.getsize(path)
+
+
+def make_recordio(prefix: str, mb: int, nparts: int = 4,
+                  seed: int = 0) -> List[str]:
+    """ImageNet-.rec-shaped: multi-part files of ~100KB binary records."""
+    from dmlc_tpu.io.recordio import RecordIOWriter
+    from dmlc_tpu.io.stream import create_stream
+    paths = [f"{prefix}.part{k}.rec" for k in range(nparts)]
+    per_part = (mb << 20) // nparts
+    rng = np.random.RandomState(seed)
+    for p in paths:
+        if os.path.exists(p) and os.path.getsize(p) >= per_part * 3 // 4:
+            continue
+        with create_stream(p, "w") as s:
+            w = RecordIOWriter(s)
+            written = 0
+            while written < per_part:
+                rec = rng.bytes(rng.randint(60_000, 140_000))
+                w.write_record(rec)
+                written += len(rec) + 8
+    return paths
+
+
+def make_parquet(path: str, mb: int, seed: int = 0) -> int:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    if os.path.exists(path) and os.path.getsize(path) >= (mb << 20) // 4:
+        return os.path.getsize(path)
+    rng = np.random.RandomState(seed)
+    nrows = (mb << 20) // 120  # ~30 float32 cols
+    cols = {"label": pa.array(rng.randint(0, 2, nrows).astype(np.float32))}
+    for c in range(28):
+        cols[f"f{c}"] = pa.array(rng.rand(nrows).astype(np.float32))
+    pq.write_table(pa.table(cols), path, row_group_size=max(1, nrows // 16))
+    return os.path.getsize(path)
+
+
+# ---------------------------------------------------------------- configs
+
+def bench_libsvm(mb: int) -> Dict:
+    from dmlc_tpu.data.row_iter import RowBlockIter
+    path = f"{_TMP}.a1a.libsvm"
+    size = make_libsvm(path, mb)
+    t0 = time.perf_counter()
+    it = RowBlockIter.create(path, 0, 1, format="libsvm")
+    rows = nnz = 0
+    for b in it:
+        rows += b.size
+        nnz += b.nnz
+    dt = time.perf_counter() - t0
+    return {"config": "libsvm_a1a", "gbps": size / dt / 1e9,
+            "bytes": size, "rows": rows, "nnz": nnz,
+            "hash": _content_hash(path, "libsvm")}
+
+
+def bench_csv(mb: int) -> Dict:
+    from dmlc_tpu.data.parser import Parser
+    path = f"{_TMP}.higgs.csv"
+    size = make_csv(path, mb)
+    t0 = time.perf_counter()
+    p = Parser.create(path, 0, 1, format="csv", label_column=0)
+    rows = nnz = 0
+    while p.next():
+        b = p.value()
+        rows += b.size
+        nnz += b.nnz
+    dt = time.perf_counter() - t0
+    if hasattr(p, "destroy"):
+        p.destroy()
+    return {"config": "csv_higgs", "gbps": size / dt / 1e9,
+            "bytes": size, "rows": rows, "nnz": nnz,
+            "hash": _content_hash(path, "csv", label_column=0)}
+
+
+def bench_recordio(mb: int) -> Dict:
+    import hashlib
+
+    from dmlc_tpu.io.input_split import InputSplit
+    paths = make_recordio(f"{_TMP}.imagenet", mb, nparts=4)
+    uri = ";".join(paths)
+    size = sum(os.path.getsize(p) for p in paths)
+    # sharded read across 4 parts, coverage-hashed
+    t0 = time.perf_counter()
+    nrec = 0
+    digest = hashlib.sha256()
+    for k in range(4):
+        sp = InputSplit.create(uri, k, 4, "recordio")
+        for rec in sp:
+            nrec += 1
+            digest.update(hashlib.sha256(rec).digest())
+    dt = time.perf_counter() - t0
+    return {"config": "recordio_imagenet", "gbps": size / dt / 1e9,
+            "bytes": size, "records": nrec, "hash": digest.hexdigest()[:16]}
+
+
+def bench_prefetch(mb: int, device: bool) -> Dict:
+    """Multi-host shape: every part parsed with prefetch pipeline (one
+    process enumerates all part_index values, SURVEY §4), transfers to
+    the accelerator overlapped when present."""
+    from dmlc_tpu.data.parser import Parser
+    path = f"{_TMP}.criteo.libsvm"
+    size = 0
+    rng = np.random.RandomState(7)
+    if not (os.path.exists(path)
+            and os.path.getsize(path) >= (mb << 20) * 3 // 4):
+        rows = []
+        for i in range(4000):
+            nnz = rng.randint(25, 45)
+            idx = np.sort(rng.choice(10 ** 6, nnz, replace=False))
+            vals = rng.rand(nnz)
+            rows.append(f"{i % 2} " + " ".join(
+                f"{j}:{v:.6f}" for j, v in zip(idx, vals)))
+        block = ("\n".join(rows) + "\n").encode()
+        with open(path, "wb") as f:
+            for _ in range(max(1, (mb << 20) // len(block))):
+                f.write(block)
+    size = os.path.getsize(path)
+    nhosts = 4
+    dev = None
+    if device:
+        import jax
+        dev = jax.devices()[0]
+    t0 = time.perf_counter()
+    rows = 0
+    in_flight: List = []
+    for k in range(nhosts):
+        p = Parser.create(path, k, nhosts, format="libsvm",
+                          chunk_size=32 << 20)
+        while p.next():
+            b = p.value()
+            rows += b.size
+            if dev is not None:
+                import jax
+                in_flight.append(jax.device_put(
+                    {"offset": b.offset, "index": b.index,
+                     "value": b.value}, dev))
+                if len(in_flight) > 4:
+                    jax.block_until_ready(in_flight.pop(0))
+        if hasattr(p, "destroy"):
+            p.destroy()
+    if dev is not None:
+        import jax
+        jax.block_until_ready(in_flight)
+    dt = time.perf_counter() - t0
+    return {"config": "prefetch_criteo_multihost",
+            "gbps": size / dt / 1e9, "bytes": size, "rows": rows,
+            "hosts": nhosts, "to_device": bool(dev),
+            "hash": _content_hash(path, "libsvm")}
+
+
+def bench_parquet(mb: int) -> Dict:
+    from dmlc_tpu.data.parser import Parser
+    path = f"{_TMP}.table.parquet"
+    size = make_parquet(path, mb)
+    t0 = time.perf_counter()
+    p = Parser.create(path, 0, 1, format="parquet", label_column="label")
+    rows = nnz = 0
+    while p.next():
+        b = p.value()
+        rows += b.size
+        nnz += b.nnz
+    dt = time.perf_counter() - t0
+    return {"config": "parquet_columnar", "gbps": size / dt / 1e9,
+            "bytes": size, "rows": rows, "nnz": nnz,
+            "hash": _content_hash(path, "parquet", label_column="label")}
+
+
+CONFIGS = {
+    1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
+    2: ("csv", lambda mb, dev: bench_csv(mb)),
+    3: ("recordio", lambda mb, dev: bench_recordio(mb)),
+    4: ("prefetch", bench_prefetch),
+    5: ("parquet", lambda mb, dev: bench_parquet(mb)),
+}
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", type=int, default=0,
+                    help="1-5 (0 = all)")
+    ap.add_argument("--mb", type=int, default=64,
+                    help="approx data size per config in MB")
+    ap.add_argument("--device", action="store_true",
+                    help="include device transfer in config 4")
+    args = ap.parse_args(argv)
+    picks = [args.config] if args.config else sorted(CONFIGS)
+    for n in picks:
+        name, fn = CONFIGS[n]
+        _log(f"— config {n} ({name}), ~{args.mb} MB —")
+        try:
+            out = fn(args.mb, args.device)
+            out["gbps"] = round(out["gbps"], 4)
+            _emit(out)
+        except Exception as e:  # noqa: BLE001
+            _emit({"config": name, "error": str(e)[:200]})
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,7 @@ class LibFMParser(TextParserBase):
             label = parse_float32(toks[0])
             n = len(toks) - 1
             fields = np.empty(n, np.int64)
-            idxs = np.empty(n, np.int64)
+            idxs = np.empty(n, np.uint64)
             vals = np.empty(n, np.float32)
             for j, t in enumerate(toks[1:]):
                 parts = t.split(b":")
@@ -61,9 +61,9 @@ class LibFMParser(TextParserBase):
         shift = self._resolved_mode
         for label, fields, idxs, vals in rows:
             if shift:
-                idxs = idxs - shift
-                if len(idxs) and idxs.min() < 0:
+                if len(idxs) and int(idxs.min()) == 0:
                     raise DMLCError("libfm: index 0 with indexing_mode=1")
+                idxs = idxs - np.uint64(shift)
             container.push(label, idxs.astype(self.index_dtype), vals,
                            fields=fields)
 

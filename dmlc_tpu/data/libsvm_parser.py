@@ -51,7 +51,7 @@ class LibSVMParser(TextParserBase):
             if feats and feats[0].startswith(b"qid:"):
                 qid = parse_index(feats[0][4:])
                 feats = feats[1:]
-            idxs = np.empty(len(feats), np.int64)
+            idxs = np.empty(len(feats), np.uint64)
             vals = np.empty(len(feats), np.float32)
             for j, t in enumerate(feats):
                 i, sep, v = t.rpartition(b":")
@@ -69,10 +69,12 @@ class LibSVMParser(TextParserBase):
         shift = self._resolved_mode
         for label, idxs, vals, qid in rows:
             if shift:
-                idxs = idxs - shift
-                if len(idxs) and idxs.min() < 0:
+                # uint64 arrays: reject zero BEFORE subtracting (no
+                # negative sentinel exists in unsigned space)
+                if len(idxs) and int(idxs.min()) == 0:
                     raise DMLCError(
                         "libsvm: index 0 found with indexing_mode=1")
+                idxs = idxs - np.uint64(shift)
             container.push(label, idxs.astype(self.index_dtype), vals, qid=qid)
 
 

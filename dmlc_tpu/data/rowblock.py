@@ -182,13 +182,22 @@ class RowBlockContainer:
         self.clear()
 
     def clear(self) -> None:
-        self._offset: List[int] = [0]
-        self._label: List[float] = []
-        self._weight: List[float] = []
-        self._qid: List[int] = []
-        self._field: List[int] = []
+        # Row-wise fields live in two tiers: cheap Python "slab" lists fed
+        # by per-row push() (the Python-engine hot path), and ndarray
+        # chunks fed by push_block() (the native-engine drain path — must
+        # never box rows). get_block() flushes slabs and concatenates.
+        self._nrows = 0
+        self._s_len: List[int] = []
+        self._s_label: List[float] = []
+        self._s_weight: List[float] = []
+        self._s_qid: List[int] = []
+        self._c_len: List[np.ndarray] = []
+        self._c_label: List[np.ndarray] = []
+        self._c_weight: List[np.ndarray] = []
+        self._c_qid: List[np.ndarray] = []
         self._index: List[np.ndarray] = []
         self._value: List[Optional[np.ndarray]] = []
+        self._field: List[Optional[np.ndarray]] = []
         self._has_value = False
         self._has_weight = False
         self._has_qid = False
@@ -197,7 +206,19 @@ class RowBlockContainer:
 
     @property
     def size(self) -> int:
-        return len(self._label)
+        return self._nrows
+
+    def _flush_slabs(self) -> None:
+        if not self._s_len:
+            return
+        self._c_len.append(np.asarray(self._s_len, np.int64))
+        self._c_label.append(np.asarray(self._s_label, np.float32))
+        self._c_weight.append(np.asarray(self._s_weight, np.float32))
+        self._c_qid.append(np.asarray(self._s_qid, np.int64))
+        self._s_len = []
+        self._s_label = []
+        self._s_weight = []
+        self._s_qid = []
 
     def push(self, label: float, indices, values=None, weight: float = 1.0,
              qid: int = -1, fields=None) -> None:
@@ -210,32 +231,74 @@ class RowBlockContainer:
             self._has_value = True
         self._value.append(
             None if values is None else np.asarray(values, np.float32))
-        self._label.append(np.float32(label))
+        self._s_label.append(float(label))
         if weight != 1.0:
             self._has_weight = True
-        self._weight.append(np.float32(weight))
+        self._s_weight.append(float(weight))
         if qid != -1:
             self._has_qid = True
-        self._qid.append(int(qid))
+        self._s_qid.append(int(qid))
         if fields is not None:
             self._has_field = True
             self._field.append(np.asarray(fields, np.int64))
         else:
             self._field.append(None)
-        self._offset.append(self._offset[-1] + len(idx))
+        self._s_len.append(len(idx))
+        self._nrows += 1
 
     def push_block(self, block: RowBlock) -> None:
-        """Append a whole RowBlock (reference: Push(RowBlock))."""
-        for row in block:
-            self.push(float(row.label), row.index,
-                      None if row.value is None else row.value,
-                      weight=float(row.weight), qid=row.qid,
-                      fields=row.field)
+        """Append a whole RowBlock (reference: Push(RowBlock)).
+
+        Vectorized: whole arrays become chunks (one chunk spans the whole
+        block; get_block concatenates chunks, so per-row and per-block
+        pushes mix freely). This is the path BasicRowIter/DiskRowIter
+        drain through — no per-row Python objects are created.
+        """
+        n = block.size
+        if n == 0:
+            return
+        self._flush_slabs()
+        off = np.asarray(block.offset, np.int64)
+        self._c_len.append(off[1:] - off[:-1])
+        self._c_label.append(np.asarray(block.label, np.float32))
+        if block.weight is not None:
+            w = np.asarray(block.weight, np.float32)
+            if bool(np.any(w != 1.0)):
+                self._has_weight = True
+            self._c_weight.append(w)
+        else:
+            self._c_weight.append(np.ones(n, np.float32))
+        if block.qid is not None:
+            q = np.asarray(block.qid, np.int64)
+            if bool(np.any(q != -1)):
+                self._has_qid = True
+            self._c_qid.append(q)
+        else:
+            self._c_qid.append(np.full(n, -1, np.int64))
+        idx = np.asarray(block.index, self.index_dtype)
+        self._index.append(idx)
+        if len(idx):
+            self.max_index = max(self.max_index, int(idx.max()))
+        if block.value is not None:
+            self._has_value = True
+            self._value.append(np.asarray(block.value, np.float32))
+        else:
+            self._value.append(None)
+        if block.field is not None:
+            self._has_field = True
+            self._field.append(np.asarray(block.field, np.int64))
+        else:
+            self._field.append(None)
+        self._nrows += n
 
     def get_block(self) -> RowBlock:
         """Materialize as an immutable RowBlock (reference: GetBlock)."""
+        self._flush_slabs()
         n = self.size
-        nnz = self._offset[-1]
+        offset = np.zeros(n + 1, np.int64)
+        if self._c_len:
+            np.cumsum(np.concatenate(self._c_len), out=offset[1:])
+        nnz = int(offset[-1])
         index = (np.concatenate(self._index) if nnz else
                  np.empty(0, self.index_dtype)).astype(self.index_dtype,
                                                        copy=False)
@@ -250,14 +313,22 @@ class RowBlockContainer:
             fparts = [f if f is not None else np.zeros(len(i), np.int64)
                       for f, i in zip(self._field, self._index)]
             field = (np.concatenate(fparts) if nnz else np.empty(0, np.int64))
+        label = (np.concatenate(self._c_label) if self._c_label
+                 else np.empty(0, np.float32))
+        weight = qid = None
+        if self._has_weight:
+            weight = (np.concatenate(self._c_weight) if self._c_weight
+                      else np.empty(0, np.float32))
+        if self._has_qid:
+            qid = (np.concatenate(self._c_qid) if self._c_qid
+                   else np.empty(0, np.int64))
         return RowBlock(
-            offset=np.asarray(self._offset, np.int64),
-            label=np.asarray(self._label, np.float32),
+            offset=offset,
+            label=label,
             index=index,
             value=value,
-            weight=np.asarray(self._weight, np.float32)
-            if self._has_weight else None,
-            qid=np.asarray(self._qid, np.int64) if self._has_qid else None,
+            weight=weight,
+            qid=qid,
             field=field)
 
     # -- binary page format (reference: RowBlockContainer::Save/Load)

@@ -52,8 +52,13 @@ struct EngineError {
 // the estimated decimal exponent decides which (ERANGE can only happen at
 // |exp10| >> 0, so the estimate needs no precision).
 bool parse_f64_slow(const char* b, const char* e, double* out) {
-  // strtod/Python accept a leading '+'; from_chars does not
-  if (b < e && *b == '+' && e - b > 1) ++b;
+  // strtod/Python accept a leading '+'; from_chars does not. After
+  // stripping it a second sign must be rejected ('+-1.5' would otherwise
+  // hand '-1.5' to from_chars and silently accept what the golden rejects)
+  if (b < e && *b == '+' && e - b > 1) {
+    ++b;
+    if (*b == '+' || *b == '-') return false;
+  }
   auto r = std::from_chars(b, e, *out);
   if (r.ec == std::errc() && r.ptr == e) return true;
   if (r.ec == std::errc::result_out_of_range && r.ptr == e) {
@@ -180,7 +185,10 @@ inline bool parse_u64(const char* b, const char* e, uint64_t* out) {
 }
 
 inline bool parse_i64(const char* b, const char* e, int64_t* out) {
-  if (b < e && *b == '+' && e - b > 1) ++b;
+  if (b < e && *b == '+' && e - b > 1) {
+    ++b;
+    if (*b == '+' || *b == '-') return false;  // no double sign
+  }
   auto r = std::from_chars(b, e, *out);
   return r.ec == std::errc() && r.ptr == e;
 }

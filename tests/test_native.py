@@ -265,3 +265,40 @@ class TestTruncatedFile:
             while parser.next():
                 pass
         parser.destroy()
+
+
+class TestDoubleSignRejection:
+    """'+-1.5' must be rejected by BOTH engines (regression: the native
+    slow path stripped '+' then let from_chars accept the second sign)."""
+
+    @pytest.mark.parametrize("line", [b"1 2:+-1.5\n", b"1 qid:+-7 2:1.0\n",
+                                      b"+-1 2:1.0\n"])
+    def test_rejected_by_both(self, tmp_path, line):
+        p = tmp_path / "ds.libsvm"
+        p.write_bytes(line)
+        with pytest.raises(Exception):
+            parse_all(str(p), "python")
+        with pytest.raises(DMLCError):
+            parse_all(str(p), "native")
+
+    def test_huge_index_uint64_parity(self, tmp_path):
+        """Indices in [2^63, 2^64) flow through both engines (regression:
+        the golden stored them in int64 and crashed with OverflowError)."""
+        big = 2 ** 63 + 5
+        p = tmp_path / "big.libsvm"
+        p.write_bytes(f"1 {big}:1.5\n".encode())
+
+        def parse64(engine):
+            c = RowBlockContainer(np.uint64)
+            pr = Parser.create(str(p), 0, 1, format="libsvm", engine=engine,
+                               index_dtype=np.uint64)
+            for b in pr:
+                c.push_block(b)
+            if hasattr(pr, "destroy"):
+                pr.destroy()
+            return c.get_block()
+
+        g, n = parse64("python"), parse64("native")
+        assert g.content_hash() == n.content_hash()
+        assert int(g.index[0]) == big
+        assert int(n.index[0]) == big
