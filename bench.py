@@ -81,9 +81,11 @@ def main() -> None:
         warm.destroy()
 
     # chunks sized so each device_put stays well under the tunnel's
-    # large-transfer cliff (~128 MB) while amortizing its fixed latency
+    # large-transfer cliff (throughput peaks near ~4-8 MB per transfer
+    # and halves by ~32 MB) while amortizing per-chunk overhead
+    chunk_mb = int(os.environ.get("DMLC_TPU_BENCH_CHUNK_MB", "8"))
     parser = Parser.create(DATA, 0, 1, format="libsvm", engine="auto",
-                           chunk_size=32 << 20)
+                           chunk_size=chunk_mb << 20)
 
     def epoch():
         parser.before_first()

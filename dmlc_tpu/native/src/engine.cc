@@ -107,59 +107,142 @@ bool parse_f64_slow(const char* b, const char* e, double* out) {
 // the class (too many digits, big exponent, inf/nan spellings, hex) falls
 // back to parse_f64_slow. This covers the overwhelmingly common "%g"/"%f"
 // tokens in libsvm/csv data at a fraction of from_chars' cost.
+//
+// The SWAR helpers below (load8 / digit_run_len / parse8) gather feature-
+// index digit runs 8 bytes at a time; measured faster than a char loop
+// for pure-digit index tokens, slower for the dot-split float runs
+// (which therefore use a char loop in parse_f64_prefix).
 const double kPow10[23] = {
     1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10, 1e11,
     1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
 
-inline bool parse_f64(const char* b, const char* e, double* out) {
+const uint64_t kPow10U64[9] = {1ULL,      10ULL,      100ULL,
+                               1000ULL,   10000ULL,   100000ULL,
+                               1000000ULL, 10000000ULL, 100000000ULL};
+
+// the SWAR digit helpers put the first character in the low byte
+static_assert(__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__,
+              "SWAR digit parsing assumes a little-endian target");
+
+// 8-byte load clamped at the readable end (zero-fill past it; zero bytes
+// are non-digits so run length is unaffected)
+inline uint64_t load8(const char* p, const char* hard_end) {
+  uint64_t w = 0;
+  if (hard_end - p >= 8)
+    std::memcpy(&w, p, 8);
+  else if (p < hard_end)
+    std::memcpy(&w, p, (size_t)(hard_end - p));
+  return w;
+}
+
+// length (0..8) of the leading run of ASCII-digit bytes in w
+inline int digit_run_len(uint64_t w) {
+  // per-byte classify: m byte == 0x33 iff digit. A carry in the +0x06 can
+  // only originate at a non-digit byte (≥0xFA), i.e. beyond the run it
+  // would corrupt — leading-run length is unaffected.
+  uint64_t m = (w & 0xF0F0F0F0F0F0F0F0ULL) |
+               (((w + 0x0606060606060606ULL) & 0xF0F0F0F0F0F0F0F0ULL) >> 4);
+  uint64_t nd = m ^ 0x3333333333333333ULL;  // 0x00 at digit bytes
+  uint64_t zero =
+      (nd - 0x0101010101010101ULL) & ~nd & 0x8080808080808080ULL;
+  uint64_t nz = ~zero & 0x8080808080808080ULL;  // 0x80 at non-digit bytes
+  return nz ? (int)(__builtin_ctzll(nz) >> 3) : 8;
+}
+
+// value of an 8-digit byte string (first char in the low byte)
+inline uint64_t parse8(uint64_t w) {
+  const uint64_t mask = 0x000000FF000000FFULL;
+  const uint64_t mul1 = 0x000F424000000064ULL;  // 100 + (1000000 << 32)
+  const uint64_t mul2 = 0x0000271000000001ULL;  // 1 + (10000 << 32)
+  w -= 0x3030303030303030ULL;
+  w = (w * 10) + (w >> 8);
+  return (((w & mask) * mul1) + (((w >> 16) & mask) * mul2)) >> 32;
+}
+
+// value of the first k (1..8) digit bytes of w: shift the digits to the
+// high bytes and fill the vacated low (leading-weight) bytes with '0'
+inline uint64_t parse_digits_k(uint64_t w, int k) {
+  if (k == 8) return parse8(w);
+  return parse8((w << ((8 - k) * 8)) |
+                (0x3030303030303030ULL >> (k * 8)));
+}
+
+// Fused scan+parse: consume a decimal starting at b without knowing the
+// token end, stopping at the first byte that cannot continue it. Returns
+// the end of the consumed prefix on fast-path success (value correctly
+// rounded via Clinger), nullptr when the token needs the tokenize-then-
+// exact-path treatment (long mantissa, inf/nan, big exponent, malformed).
+// The caller must check the returned end lands on a token boundary.
+// Digit gathering is a plain char loop: measured faster than SWAR 8-digit
+// tricks on the short (≤7-digit) runs that dominate ML text data.
+inline const char* parse_f64_prefix(const char* b, const char* hard_end,
+                                    double* out) {
   const char* p = b;
-  if (p < e && (*p == '+' || *p == '-')) ++p;
-  bool neg = (b < e && *b == '-');
+  if (p < hard_end && (*p == '+' || *p == '-')) ++p;
+  bool neg = (b < hard_end && *b == '-');
   uint64_t mant = 0;
-  int exp10 = 0;
-  bool any_digit = false, seen_point = false, overflow = false;
-  for (; p < e; ++p) {
+  int ndigits = 0, exp10 = 0;
+  while (p < hard_end) {  // integer digits
     unsigned d = (unsigned)(*p - '0');
-    if (d <= 9) {
-      any_digit = true;
-      if (mant > ((UINT64_MAX - 9) / 10)) { overflow = true; break; }
-      mant = mant * 10 + d;
-      if (seen_point) --exp10;
-      continue;
-    }
-    if (*p == '.') {
-      if (seen_point) return false;
-      seen_point = true;
-      continue;
-    }
-    break;
-  }
-  if (!overflow && any_digit && p < e && (*p == 'e' || *p == 'E')) {
+    if (d > 9) break;
+    mant = mant * 10 + d;
+    ++ndigits;
     ++p;
-    bool eneg = false;
-    if (p < e && (*p == '+' || *p == '-')) { eneg = (*p == '-'); ++p; }
-    if (p >= e) return false;
-    long ev = 0;
-    for (; p < e; ++p) {
+  }
+  bool any_digit = ndigits > 0;
+  if (p < hard_end && *p == '.') {
+    ++p;
+    const char* fs = p;
+    while (p < hard_end) {  // fraction digits
       unsigned d = (unsigned)(*p - '0');
+      if (d > 9) break;
+      mant = mant * 10 + d;
+      ++p;
+    }
+    ndigits += (int)(p - fs);
+    exp10 -= (int)(p - fs);
+    any_digit = any_digit || p != fs;
+  }
+  // >19 digits may have wrapped mant — hand the whole token to the exact
+  // path (leading zeros land there too; correct either way, just slower)
+  if (!any_digit || ndigits > 19) return nullptr;
+  if (p < hard_end && (*p == 'e' || *p == 'E')) {
+    const char* ep = p + 1;
+    bool eneg = false;
+    if (ep < hard_end && (*ep == '+' || *ep == '-')) {
+      eneg = (*ep == '-');
+      ++ep;
+    }
+    const char* ds = ep;
+    long ev = 0;
+    for (; ep < hard_end; ++ep) {
+      unsigned d = (unsigned)(*ep - '0');
       if (d > 9) break;
       if (ev < 100000) ev = ev * 10 + (long)d;
     }
+    if (ep == ds) return nullptr;  // "1e" / "1ex": exact path decides
     exp10 += (int)(eneg ? -ev : ev);
+    p = ep;
   }
-  if (!overflow && p == e && any_digit) {
-    if (mant == 0) {
-      *out = neg ? -0.0 : 0.0;
-      return true;
-    }
-    if (mant <= (1ULL << 53) && exp10 >= -22 && exp10 <= 22) {
-      double d = (double)mant;
-      if (exp10 > 0) d *= kPow10[exp10];
-      else if (exp10 < 0) d /= kPow10[-exp10];
-      *out = neg ? -d : d;
-      return true;
-    }
+  if (mant == 0) {
+    *out = neg ? -0.0 : 0.0;
+    return p;
   }
+  if (mant <= (1ULL << 53) && exp10 >= -22 && exp10 <= 22) {
+    double d = (double)mant;
+    if (exp10 > 0) d *= kPow10[exp10];
+    else if (exp10 < 0) d /= kPow10[-exp10];
+    *out = neg ? -d : d;
+    return p;
+  }
+  return nullptr;
+}
+
+inline bool parse_f64(const char* b, const char* e, double* out) {
+  const char* p = parse_f64_prefix(b, e, out);
+  if (p == e && p != nullptr) return true;
+  // trailing junk, second '.', huge mantissa/exponent, inf/nan spellings:
+  // the exact path accepts or rejects with strtod semantics
   return parse_f64_slow(b, e, out);
 }
 
@@ -169,6 +252,7 @@ inline bool parse_f32(const char* b, const char* e, float* out) {
   *out = static_cast<float>(d);
   return true;
 }
+
 
 inline bool parse_u64(const char* b, const char* e, uint64_t* out) {
   if (b < e && *b == '+' && e - b > 1) ++b;
@@ -423,11 +507,20 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
     // tokens within [q, line_end)
     while (q < line_end && is_ws(*q)) ++q;
     if (q == line_end) continue;  // blank line
-    const char* tok_end = q;
-    while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
     float label;
-    if (!parse_f32(q, tok_end, &label))
-      throw EngineError{"libsvm: bad label '" + std::string(q, tok_end) + "'"};
+    double dlabel;
+    const char* tok_end;
+    const char* pend = parse_f64_prefix(q, line_end, &dlabel);
+    if (pend && (pend == line_end || is_ws(*pend))) {
+      label = (float)dlabel;
+      tok_end = pend;
+    } else {
+      tok_end = q;
+      while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
+      if (!parse_f32(q, tok_end, &label))
+        throw EngineError{"libsvm: bad label '" + std::string(q, tok_end) +
+                          "'"};
+    }
     int64_t qid = -1;
     q = tok_end;
     size_t row_nnz = 0;
@@ -444,7 +537,15 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
       if (s < line_end && *s == '+') ++s;  // golden contract allows '+'
       const char* dstart = s;
       uint64_t idx = 0;
-      while (s < line_end) {
+      while (s < line_end) {  // SWAR bulk: first ≤19 digits can't overflow
+        uint64_t w = load8(s, line_end);
+        int k = digit_run_len(w);
+        if (k == 0 || (s - dstart) + k > 19) break;
+        idx = idx * kPow10U64[k] + parse_digits_k(w, k);
+        s += k;
+        if (k < 8) break;
+      }
+      while (s < line_end) {  // tail with exact overflow semantics
         unsigned d = (unsigned)(*s - '0');
         if (d > 9) break;
         if (idx > (UINT64_MAX - d) / 10) { s = dstart; break; }  // overflow
@@ -469,11 +570,18 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
                           std::string(q, tok_end) + "'"};
       }
       const char* vb = ++s;
-      while (s < line_end && !is_ws(*s)) ++s;
       float val;
-      if (!parse_f32(vb, s, &val))
-        throw EngineError{"libsvm: bad feature token '" +
-                          std::string(q, s) + "'"};
+      double dval;
+      const char* vend = parse_f64_prefix(vb, line_end, &dval);
+      if (vend && (vend == line_end || is_ws(*vend))) {
+        val = (float)dval;
+        s = vend;
+      } else {
+        while (s < line_end && !is_ws(*s)) ++s;
+        if (!parse_f32(vb, s, &val))
+          throw EngineError{"libsvm: bad feature token '" +
+                            std::string(q, s) + "'"};
+      }
       a->index.push_back(idx);
       a->value.push_back(val);
       a->min_index = std::min(a->min_index, idx);
