@@ -110,11 +110,11 @@ def main() -> None:
             jax.block_until_ready(x)
         return time.perf_counter() - t0, t_parse, rows, nnz
 
-    # two epochs, keep the best: this host's CPU is burstable and the
+    # three epochs, keep the best: this host's CPU is burstable and the
     # first pass often runs throttled; the steady-state pass is the
     # honest hardware number
     best = None
-    for i in range(2):
+    for i in range(3):
         dt, t_parse, rows, nnz = epoch()
         log(f"epoch {i}: rows={rows} nnz={nnz} wall={dt:.2f}s "
             f"parse-only={t_parse:.2f}s -> {size / dt / 1e9:.3f} GB/s")

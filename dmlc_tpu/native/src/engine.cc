@@ -287,7 +287,11 @@ struct CSRArena {
   std::vector<float> label;
   std::vector<float> weight;
   std::vector<int64_t> qid;
-  std::vector<uint64_t> index;  // widened; narrowed at the ABI if u32
+  // indices are parsed straight into u32 (the RowBlock default dtype, and
+  // zero-copy at the ABI); the first >u32 index widens the block to u64
+  std::vector<uint32_t> index32;
+  std::vector<uint64_t> index64;
+  bool wide = false;
   std::vector<float> value;
   std::vector<int64_t> field;
   bool has_weight = false, has_qid = false, has_field = false;
@@ -295,7 +299,26 @@ struct CSRArena {
   uint64_t max_index = 0;
 
   size_t rows() const { return label.size(); }
-  size_t nnz() const { return index.size(); }
+  size_t nnz() const { return wide ? index64.size() : index32.size(); }
+
+  void widen() {
+    if (wide) return;
+    index64.reserve(index32.size() + 1024);
+    index64.assign(index32.begin(), index32.end());
+    index32.clear();
+    wide = true;
+  }
+
+  void push_index(uint64_t ix) {
+    if (!wide) {
+      if (ix <= UINT32_MAX) {
+        index32.push_back((uint32_t)ix);
+        return;
+      }
+      widen();
+    }
+    index64.push_back(ix);
+  }
 
   // reset content, keep vector capacity (arenas are pooled across chunks
   // to avoid large-allocation mmap/munmap + page-fault churn per chunk)
@@ -303,10 +326,34 @@ struct CSRArena {
     offset.clear();
     offset.push_back(0);
     label.clear(); weight.clear(); qid.clear();
-    index.clear(); value.clear(); field.clear();
+    index32.clear(); index64.clear(); value.clear(); field.clear();
+    wide = false;
     has_weight = has_qid = has_field = false;
     min_index = UINT64_MAX;
     max_index = 0;
+  }
+
+  // libsvm/libfm defer min/max to this single auto-vectorizable pass
+  // instead of two updates per feature in the parse loop (CSV derives
+  // its range from the column count during parse)
+  void compute_index_range() {
+    if (wide) {
+      uint64_t mn = UINT64_MAX, mx = 0;
+      for (uint64_t ix : index64) {
+        mn = std::min(mn, ix);
+        mx = std::max(mx, ix);
+      }
+      min_index = mn;
+      max_index = mx;
+    } else {
+      uint32_t mn = UINT32_MAX, mx = 0;
+      for (uint32_t ix : index32) {
+        mn = std::min(mn, ix);
+        mx = std::max(mx, ix);
+      }
+      min_index = index32.empty() ? UINT64_MAX : mn;
+      max_index = mx;
+    }
   }
 
   void append(CSRArena&& o) {
@@ -317,8 +364,15 @@ struct CSRArena {
     auto cat = [](auto& dst, auto& src) {
       dst.insert(dst.end(), src.begin(), src.end());
     };
+    if (o.wide) widen();
+    if (wide) {
+      o.widen();
+      cat(index64, o.index64);
+    } else {
+      cat(index32, o.index32);
+    }
     cat(label, o.label); cat(weight, o.weight); cat(qid, o.qid);
-    cat(index, o.index); cat(value, o.value); cat(field, o.field);
+    cat(value, o.value); cat(field, o.field);
     has_weight |= o.has_weight; has_qid |= o.has_qid; has_field |= o.has_field;
     min_index = std::min(min_index, o.min_index);
     max_index = std::max(max_index, o.max_index);
@@ -495,7 +549,7 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
   a->weight.reserve(bytes / 64);
   a->qid.reserve(bytes / 64);
   a->offset.reserve(bytes / 64 + 1);
-  a->index.reserve(bytes / 12);
+  a->index32.reserve(bytes / 12);
   a->value.reserve(bytes / 12);
   const char* p = b;
   while (p < e) {
@@ -582,10 +636,8 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
           throw EngineError{"libsvm: bad feature token '" +
                             std::string(q, s) + "'"};
       }
-      a->index.push_back(idx);
+      a->push_index(idx);
       a->value.push_back(val);
-      a->min_index = std::min(a->min_index, idx);
-      a->max_index = std::max(a->max_index, idx);
       ++row_nnz;
       seen_feature = true;
       q = s;
@@ -629,7 +681,7 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
       } else if (col == cfg.weight_column) {
         weight = v;
       } else {
-        a->index.push_back((uint64_t)fidx);
+        a->push_index((uint64_t)fidx);
         a->value.push_back(v);
         ++fidx;
         ++row_nnz;
@@ -696,10 +748,8 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
         throw EngineError{"libfm: bad token '" + std::string(q, tok_end) +
                           "' (want field:idx:val)"};
       a->field.push_back(fld);
-      a->index.push_back(idx);
+      a->push_index(idx);
       a->value.push_back(val);
-      a->min_index = std::min(a->min_index, idx);
-      a->max_index = std::max(a->max_index, idx);
       ++row_nnz;
       q = tok_end;
     }
@@ -768,6 +818,7 @@ void ParseChunk(const std::string& chunk, const ParserConfig& cfg,
   for (auto& err : errors)
     if (!err.empty()) throw EngineError{err};
   for (auto& part : parts) out->append(std::move(part));
+  if (cfg.format != Format::kCSV) out->compute_index_range();
 }
 
 // ------------------------------------------------------------- pipeline
@@ -839,7 +890,6 @@ struct ParserHandle {
   std::unique_ptr<BoundedQueue<std::pair<std::unique_ptr<CSRArena>,
                                          std::string>>> blocks;
   std::unique_ptr<CSRArena> current;        // block handed to consumer
-  std::vector<uint32_t> index32;            // narrowed view storage
   std::atomic<long> ncol{-1};
   int resolved_mode = 0;
   bool mode_resolved = false;
@@ -927,7 +977,10 @@ struct ParserHandle {
           error = "index 0 found with indexing_mode=1";
           return -1;
         }
-        for (auto& ix : a->index) ix -= 1;
+        if (a->wide)
+          for (auto& ix : a->index64) ix -= 1;
+        else
+          for (auto& ix : a->index32) ix -= 1;
         if (a->nnz()) {
           a->min_index -= 1;
           a->max_index -= 1;
@@ -1015,18 +1068,14 @@ int64_t dtp_parser_next(void* handle, const int64_t** offset,
   *value = a->value.data();
   *field = a->has_field ? a->field.data() : nullptr;
   *nnz = (int64_t)a->nnz();
-  // narrow index to u32 when it fits (the default RowBlock dtype);
-  // max_index is tracked during parse so this is O(1)
-  bool fits32 = a->max_index <= UINT32_MAX;
-  if (fits32) {
-    h->index32.resize(a->index.size());
-    for (size_t i = 0; i < a->index.size(); ++i)
-      h->index32[i] = (uint32_t)a->index[i];
-    *index32 = h->index32.data();
+  // indices were parsed straight into u32 unless a >u32 index widened
+  // the block, so both paths are zero-copy here
+  if (!a->wide) {
+    *index32 = a->index32.data();
     *index64 = nullptr;
   } else {
     *index32 = nullptr;
-    *index64 = a->index.data();
+    *index64 = a->index64.data();
   }
   *has_weight = a->has_weight ? 1 : 0;
   *has_qid = a->has_qid ? 1 : 0;

@@ -211,3 +211,39 @@ class TestSerializer:
         s = MemoryStream(b"\x01\x02")
         with pytest.raises(DMLCError, match="EOF"):
             s.read_exact(5)
+
+
+class TestEndianGolden:
+    """On-disk byte-order goldens (reference: test/unittest/unittest_endian.cc
+    — the serialized format must be identical regardless of host endianness;
+    ours is frozen little-endian)."""
+
+    def test_scalar_goldens(self):
+        s = MemoryStream()
+        ser.write_u32(s, 0x11223344)
+        ser.write_i64(s, -2)
+        ser.write_f32(s, 1.0)
+        assert s.getvalue() == (
+            b"\x44\x33\x22\x11"                      # u32 LE
+            + b"\xfe\xff\xff\xff\xff\xff\xff\xff"    # i64 two's complement LE
+            + b"\x00\x00\x80\x3f")                   # f32 IEEE-754 LE
+
+    def test_ndarray_payload_is_le(self):
+        s = MemoryStream()
+        ser.write_ndarray(s, np.array([0x01020304], dtype=">u4"))
+        raw = s.getvalue()
+        # payload bytes (last 4) must be little-endian regardless of the
+        # source array's byte order
+        assert raw[-4:] == b"\x04\x03\x02\x01"
+
+    def test_rowblock_page_magic_bytes(self):
+        from dmlc_tpu.data.rowblock import RowBlock, RowBlockContainer
+        c = RowBlockContainer(np.uint32)
+        c.push_block(RowBlock(offset=np.array([0, 1], np.int64),
+                              label=np.array([1.0], np.float32),
+                              index=np.array([7], np.uint32),
+                              value=np.array([0.5], np.float32)))
+        s = MemoryStream()
+        c.save(s)
+        # page magic 0x42524F57 ("WORB" little-endian on disk)
+        assert s.getvalue()[:4] == b"\x57\x4f\x52\x42"
