@@ -137,6 +137,20 @@ class InputSplit:
         """A buffer of whole records (zero or more chunks per shard)."""
         raise NotImplementedError
 
+    def next_batch(self, n_records: int) -> Optional[List[bytes]]:
+        """Up to n_records records; None at end of shard (reference:
+        InputSplit::NextBatch, include/dmlc/io.h)."""
+        check(n_records > 0,
+              "next_batch(n_records) needs n_records >= 1: a zero-size "
+              "request would be indistinguishable from end-of-shard (None)")
+        out: List[bytes] = []
+        while len(out) < n_records:
+            rec = self.next_record()
+            if rec is None:
+                break
+            out.append(rec)
+        return out or None
+
     def before_first(self) -> None:
         raise NotImplementedError
 

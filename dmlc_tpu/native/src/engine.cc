@@ -551,32 +551,32 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
   a->offset.reserve(bytes / 64 + 1);
   a->index32.reserve(bytes / 12);
   a->value.reserve(bytes / 12);
+  // Single pass, no line-end pre-scan: rows are delimited by the token
+  // loop itself hitting a newline (the old find-line-end-first structure
+  // cost a full extra pass over every byte). Row-per-line semantics are
+  // preserved because every token scan stops at '\n'/'\r' and the next
+  // row starts with a fresh label parse.
   const char* p = b;
   while (p < e) {
-    while (p < e && is_nl(*p)) ++p;
-    const char* line_end = p;
-    while (line_end < e && !is_nl(*line_end)) ++line_end;
-    const char* q = p;
-    p = line_end;
-    // tokens within [q, line_end)
-    while (q < line_end && is_ws(*q)) ++q;
-    if (q == line_end) continue;  // blank line
+    // skip newlines and leading whitespace (blank/ws-only lines fold in)
+    while (p < e && (is_nl(*p) || is_ws(*p))) ++p;
+    if (p >= e) break;
     float label;
     double dlabel;
-    const char* tok_end;
-    const char* pend = parse_f64_prefix(q, line_end, &dlabel);
-    if (pend && (pend == line_end || is_ws(*pend))) {
+    const char* q;
+    const char* pend = parse_f64_prefix(p, e, &dlabel);
+    if (pend && (pend == e || is_ws(*pend) || is_nl(*pend))) {
       label = (float)dlabel;
-      tok_end = pend;
+      q = pend;
     } else {
-      tok_end = q;
-      while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
-      if (!parse_f32(q, tok_end, &label))
-        throw EngineError{"libsvm: bad label '" + std::string(q, tok_end) +
+      const char* tok_end = p;
+      while (tok_end < e && !is_ws(*tok_end) && !is_nl(*tok_end)) ++tok_end;
+      if (!parse_f32(p, tok_end, &label))
+        throw EngineError{"libsvm: bad label '" + std::string(p, tok_end) +
                           "'"};
+      q = tok_end;
     }
     int64_t qid = -1;
-    q = tok_end;
     size_t row_nnz = 0;
     bool seen_feature = false;
     // Feature tokens parse index digits in the same pass as the token
@@ -585,32 +585,33 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
     // every token with 2+ colons is an error under both rules (last-colon
     // makes the index invalid; first-colon makes the value invalid).
     while (true) {
-      while (q < line_end && is_ws(*q)) ++q;
-      if (q >= line_end) break;
+      while (q < e && is_ws(*q)) ++q;
+      if (q >= e || is_nl(*q)) break;  // end of row
       const char* s = q;
-      if (s < line_end && *s == '+') ++s;  // golden contract allows '+'
+      if (*s == '+') ++s;  // golden contract allows '+'
       const char* dstart = s;
       uint64_t idx = 0;
-      while (s < line_end) {  // SWAR bulk: first ≤19 digits can't overflow
-        uint64_t w = load8(s, line_end);
+      while (s < e) {  // SWAR bulk: first ≤19 digits can't overflow
+        uint64_t w = load8(s, e);
         int k = digit_run_len(w);
         if (k == 0 || (s - dstart) + k > 19) break;
         idx = idx * kPow10U64[k] + parse_digits_k(w, k);
         s += k;
         if (k < 8) break;
       }
-      while (s < line_end) {  // tail with exact overflow semantics
+      while (s < e) {  // tail with exact overflow semantics
         unsigned d = (unsigned)(*s - '0');
         if (d > 9) break;
         if (idx > (UINT64_MAX - d) / 10) { s = dstart; break; }  // overflow
         idx = idx * 10 + d;
         ++s;
       }
-      if (s == dstart || s >= line_end || *s != ':') {
+      if (s == dstart || s >= e || *s != ':') {
         // not "digits:..." — qid token (only directly after the label,
         // golden parity) or malformed
-        tok_end = s;
-        while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
+        const char* tok_end = s;
+        while (tok_end < e && !is_ws(*tok_end) && !is_nl(*tok_end))
+          ++tok_end;
         if (!seen_feature && tok_end - q > 4 &&
             std::memcmp(q, "qid:", 4) == 0) {
           if (!parse_i64(q + 4, tok_end, &qid))
@@ -626,12 +627,12 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
       const char* vb = ++s;
       float val;
       double dval;
-      const char* vend = parse_f64_prefix(vb, line_end, &dval);
-      if (vend && (vend == line_end || is_ws(*vend))) {
+      const char* vend = parse_f64_prefix(vb, e, &dval);
+      if (vend && (vend == e || is_ws(*vend) || is_nl(*vend))) {
         val = (float)dval;
         s = vend;
       } else {
-        while (s < line_end && !is_ws(*s)) ++s;
+        while (s < e && !is_ws(*s) && !is_nl(*s)) ++s;
         if (!parse_f32(vb, s, &val))
           throw EngineError{"libsvm: bad feature token '" +
                             std::string(q, s) + "'"};
@@ -642,6 +643,7 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
       seen_feature = true;
       q = s;
     }
+    p = q;
     a->label.push_back(label);
     a->weight.push_back(1.0f);
     a->qid.push_back(qid);
@@ -651,31 +653,52 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
 
 void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
                    std::atomic<long>* ncol_atom, CSRArena* a) {
+  // the fused prefix parse may only delimit cells itself when the
+  // delimiter cannot appear inside a decimal
+  const char d = cfg.delimiter;
+  const bool fast_ok = !(d == '.' || d == '+' || d == '-' || d == 'e' ||
+                         d == 'E' || (d >= '0' && d <= '9') || is_ws(d) ||
+                         is_nl(d));
+  // single pass, no line-end pre-scan (same structure as libsvm above)
   const char* p = b;
   while (p < e) {
     while (p < e && is_nl(*p)) ++p;
-    const char* line_end = p;
-    while (line_end < e && !is_nl(*line_end)) ++line_end;
-    const char* q = p;
-    p = line_end;
-    if (q == line_end) continue;
+    if (p >= e) break;
     float label = 0.0f, weight = 1.0f;
     long col = 0, fidx = 0;
     size_t row_nnz = 0;
-    const char* cell = q;
     bool row_done = false;
     while (!row_done) {
-      const char* cell_end = cell;
-      while (cell_end < line_end && *cell_end != cfg.delimiter) ++cell_end;
+      const char* cell = p;
+      const char* cell_end;
+      float v;
       // tolerate surrounding whitespace in cells (golden: Python float())
       const char* vb = cell;
-      const char* ve = cell_end;
-      while (vb < ve && is_ws(*vb)) ++vb;
-      while (ve > vb && is_ws(*(ve - 1))) --ve;
-      float v;
-      if (!parse_f32(vb, ve, &v))
-        throw EngineError{"csv: bad value '" +
-                          std::string(cell, cell_end) + "'"};
+      while (vb < e && is_ws(*vb)) ++vb;
+      double dv;
+      const char* pend = fast_ok ? parse_f64_prefix(vb, e, &dv) : nullptr;
+      if (pend) {
+        const char* t = pend;
+        while (t < e && is_ws(*t)) ++t;
+        if (t >= e || *t == d || is_nl(*t)) {
+          v = (float)dv;
+          cell_end = t;
+        } else {
+          pend = nullptr;
+        }
+      }
+      if (!pend) {  // exact/tokenized path: scan the cell, trim, parse
+        cell_end = cell;
+        while (cell_end < e && *cell_end != d && !is_nl(*cell_end))
+          ++cell_end;
+        const char* ve = cell_end;
+        vb = cell;
+        while (vb < ve && is_ws(*vb)) ++vb;
+        while (ve > vb && is_ws(*(ve - 1))) --ve;
+        if (!parse_f32(vb, ve, &v))
+          throw EngineError{"csv: bad value '" +
+                            std::string(cell, cell_end) + "'"};
+      }
       if (col == cfg.label_column) {
         label = v;
       } else if (col == cfg.weight_column) {
@@ -687,8 +710,12 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
         ++row_nnz;
       }
       ++col;
-      if (cell_end >= line_end) row_done = true;
-      cell = cell_end + 1;
+      if (cell_end >= e || is_nl(*cell_end)) {
+        row_done = true;
+        p = cell_end;
+      } else {
+        p = cell_end + 1;
+      }
     }
     long expect = ncol_atom->load(std::memory_order_relaxed);
     if (expect == -1) {
@@ -715,27 +742,32 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
 }
 
 void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
+  // single pass, no line-end pre-scan (same structure as libsvm above)
   const char* p = b;
   while (p < e) {
-    while (p < e && is_nl(*p)) ++p;
-    const char* line_end = p;
-    while (line_end < e && !is_nl(*line_end)) ++line_end;
-    const char* q = p;
-    p = line_end;
-    while (q < line_end && is_ws(*q)) ++q;
-    if (q == line_end) continue;
-    const char* tok_end = q;
-    while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
+    while (p < e && (is_nl(*p) || is_ws(*p))) ++p;
+    if (p >= e) break;
     float label;
-    if (!parse_f32(q, tok_end, &label))
-      throw EngineError{"libfm: bad label '" + std::string(q, tok_end) + "'"};
-    q = tok_end;
+    double dlabel;
+    const char* q;
+    const char* pend = parse_f64_prefix(p, e, &dlabel);
+    if (pend && (pend == e || is_ws(*pend) || is_nl(*pend))) {
+      label = (float)dlabel;
+      q = pend;
+    } else {
+      const char* lab_end = p;
+      while (lab_end < e && !is_ws(*lab_end) && !is_nl(*lab_end)) ++lab_end;
+      if (!parse_f32(p, lab_end, &label))
+        throw EngineError{"libfm: bad label '" + std::string(p, lab_end) +
+                          "'"};
+      q = lab_end;
+    }
     size_t row_nnz = 0;
     while (true) {
-      while (q < line_end && is_ws(*q)) ++q;
-      if (q >= line_end) break;
-      tok_end = q;
-      while (tok_end < line_end && !is_ws(*tok_end)) ++tok_end;
+      while (q < e && is_ws(*q)) ++q;
+      if (q >= e || is_nl(*q)) break;  // end of row
+      const char* tok_end = q;
+      while (tok_end < e && !is_ws(*tok_end) && !is_nl(*tok_end)) ++tok_end;
       const char* c1 = nullptr;
       const char* c2 = nullptr;
       for (const char* c = q; c < tok_end; ++c)
@@ -753,6 +785,7 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
       ++row_nnz;
       q = tok_end;
     }
+    p = q;
     a->has_field = true;
     a->label.push_back(label);
     a->weight.push_back(1.0f);

@@ -260,3 +260,15 @@ class TestCachedSplitRegressions:
         first = s.bytes_read
         list(s)  # second epoch (replay from cache)
         assert s.bytes_read == first  # not accumulated across epochs
+
+
+def test_next_batch(tmp_path):
+    p = tmp_path / "batch.txt"
+    p.write_text("".join(f"line{i}\n" for i in range(10)))
+    sp = InputSplit.create(str(p), 0, 1, "text")
+    sp.before_first()
+    b1 = sp.next_batch(4)
+    assert [bytes(r) for r in b1] == [f"line{i}".encode() for i in range(4)]
+    b2 = sp.next_batch(100)
+    assert len(b2) == 6
+    assert sp.next_batch(3) is None
