@@ -282,6 +282,46 @@ inline bool is_nl(char c) { return c == '\n' || c == '\r'; }
 
 // ---------------------------------------------------------------- CSR arena
 
+// Growable POD buffer without std::vector's per-push capacity check cost
+// on the hot path: parse loops reserve a worst-case bound once per slice
+// (virtual memory is cheap; untouched pages never fault) and write through
+// raw cursors, syncing the size afterwards. Checked push_back remains for
+// cold paths.
+template <typename T>
+struct Buf {
+  std::unique_ptr<T[]> d;
+  size_t n = 0, cap = 0;
+
+  void reserve(size_t want) {
+    if (want <= cap) return;
+    size_t ncap = std::max(want, cap * 2);
+    std::unique_ptr<T[]> nd(new T[ncap]);  // POD: uninitialized, no memset
+    if (n) std::memcpy(nd.get(), d.get(), n * sizeof(T));
+    d = std::move(nd);
+    cap = ncap;
+  }
+
+  void push_back(T v) {
+    if (n == cap) reserve(n ? n * 2 : 1024);
+    d[n++] = v;
+  }
+
+  void append(const Buf& o) {
+    if (o.n == 0) return;  // o.d may be null; memcpy(_, null, 0) is UB
+    reserve(n + o.n);
+    std::memcpy(d.get() + n, o.d.get(), o.n * sizeof(T));
+    n += o.n;
+  }
+
+  T* data() { return d.get(); }
+  const T* data() const { return d.get(); }
+  T* begin() { return d.get(); }
+  T* end() { return d.get() + n; }
+  size_t size() const { return n; }
+  bool empty() const { return n == 0; }
+  void clear() { n = 0; }
+};
+
 struct CSRArena {
   std::vector<int64_t> offset{0};
   std::vector<float> label;
@@ -289,10 +329,10 @@ struct CSRArena {
   std::vector<int64_t> qid;
   // indices are parsed straight into u32 (the RowBlock default dtype, and
   // zero-copy at the ABI); the first >u32 index widens the block to u64
-  std::vector<uint32_t> index32;
+  Buf<uint32_t> index32;
   std::vector<uint64_t> index64;
   bool wide = false;
-  std::vector<float> value;
+  Buf<float> value;
   std::vector<int64_t> field;
   bool has_weight = false, has_qid = false, has_field = false;
   uint64_t min_index = UINT64_MAX;
@@ -369,10 +409,11 @@ struct CSRArena {
       o.widen();
       cat(index64, o.index64);
     } else {
-      cat(index32, o.index32);
+      index32.append(o.index32);
     }
     cat(label, o.label); cat(weight, o.weight); cat(qid, o.qid);
-    cat(value, o.value); cat(field, o.field);
+    value.append(o.value);
+    cat(field, o.field);
     has_weight |= o.has_weight; has_qid |= o.has_qid; has_field |= o.has_field;
     min_index = std::min(min_index, o.min_index);
     max_index = std::max(max_index, o.max_index);
@@ -543,14 +584,19 @@ struct ParserConfig {
 
 // parse [b, e) of whole text records into arena; throws EngineError
 void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
-  // reserve from density heuristics to avoid realloc churn
+  // per-row vectors: density heuristic (cheap, checked pushes)
   size_t bytes = (size_t)(e - b);
   a->label.reserve(bytes / 64);
   a->weight.reserve(bytes / 64);
   a->qid.reserve(bytes / 64);
   a->offset.reserve(bytes / 64 + 1);
-  a->index32.reserve(bytes / 12);
-  a->value.reserve(bytes / 12);
+  // hot per-feature buffers: worst-case bound ("i:v " is ≥4 bytes per
+  // feature) reserved once so the loop can write through raw cursors
+  // with no per-push capacity check; untouched tail pages never fault
+  a->index32.reserve(a->index32.size() + bytes / 4 + 1);
+  a->value.reserve(a->value.size() + bytes / 4 + 1);
+  uint32_t* ic = a->index32.data() + a->index32.size();
+  float* vc = a->value.data() + a->value.size();
   // Single pass, no line-end pre-scan: rows are delimited by the token
   // loop itself hitting a newline (the old find-line-end-first structure
   // cost a full extra pass over every byte). Row-per-line semantics are
@@ -637,8 +683,15 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
           throw EngineError{"libsvm: bad feature token '" +
                             std::string(q, s) + "'"};
       }
-      a->push_index(idx);
-      a->value.push_back(val);
+      if (!a->wide && idx <= UINT32_MAX) {
+        *ic++ = (uint32_t)idx;  // unchecked: capacity bounded above
+      } else {
+        // rare >u32 index: sync cursor, widen, continue via checked path
+        a->index32.n = (size_t)(ic - a->index32.data());
+        a->push_index(idx);
+        ic = a->index32.data() + a->index32.size();  // stays synced when wide
+      }
+      *vc++ = val;
       ++row_nnz;
       seen_feature = true;
       q = s;
@@ -649,6 +702,8 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
     a->qid.push_back(qid);
     a->offset.push_back(a->offset.back() + (int64_t)row_nnz);
   }
+  if (!a->wide) a->index32.n = (size_t)(ic - a->index32.data());
+  a->value.n = (size_t)(vc - a->value.data());
 }
 
 void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
