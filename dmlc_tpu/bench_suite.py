@@ -271,12 +271,16 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
                     help="include device transfer in config 4")
+    ap.add_argument("--cold", action="store_true",
+                    help="skip the warm-up pass (report first-run numbers)")
     args = ap.parse_args(argv)
     picks = [args.config] if args.config else sorted(CONFIGS)
     for n in picks:
         name, fn = CONFIGS[n]
         _log(f"— config {n} ({name}), ~{args.mb} MB —")
         try:
+            if not args.cold:
+                fn(args.mb, args.device)  # warm imports + page cache
             out = fn(args.mb, args.device)
             out["gbps"] = round(out["gbps"], 4)
             _emit(out)

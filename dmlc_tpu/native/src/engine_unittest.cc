@@ -1,0 +1,239 @@
+// Native unit tests for the parse engine internals (reference:
+// test/unittest/*.cc — the gtest suite; this image has no gtest, so a
+// plain main() with CHECK macros, like the reference's manual test/
+// programs). Built and run by tests/test_native.py::test_cpp_unittests.
+//
+// Covers what the Python-side parity tests cannot see directly:
+// SWAR digit helpers over their full domain, parse_f64 vs strtod on
+// adversarial vectors, Buf growth/append, and TextShardReader's
+// boundary rule (coverage + no-overlap at byte granularity).
+
+#include "engine.cc"
+
+#include <cstdlib>
+#include <fstream>
+#include <iostream>
+#include <set>
+
+static int g_failures = 0;
+
+#define CHECK_TRUE(cond)                                                  \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::cerr << __FILE__ << ":" << __LINE__ << " CHECK failed: "       \
+                << #cond << "\n";                                         \
+      ++g_failures;                                                       \
+    }                                                                     \
+  } while (0)
+
+#define CHECK_EQ_(a, b)                                                   \
+  do {                                                                    \
+    auto va = (a);                                                        \
+    auto vb = (b);                                                        \
+    if (!(va == vb)) {                                                    \
+      std::cerr << __FILE__ << ":" << __LINE__ << " CHECK_EQ failed: "    \
+                << #a << " = " << va << " vs " << #b << " = " << vb       \
+                << "\n";                                                  \
+      ++g_failures;                                                       \
+    }                                                                     \
+  } while (0)
+
+// ---------------------------------------------------------------- SWAR
+
+static void test_digit_run_len() {
+  // all 256 byte values at every position: run length must match scalar
+  for (int pos = 0; pos < 8; ++pos) {
+    for (int c = 0; c < 256; ++c) {
+      char buf[8];
+      for (int i = 0; i < 8; ++i) buf[i] = '1';
+      buf[pos] = (char)c;
+      uint64_t w;
+      std::memcpy(&w, buf, 8);
+      int expect = 0;
+      while (expect < 8 && buf[expect] >= '0' && buf[expect] <= '9')
+        ++expect;
+      CHECK_EQ_(digit_run_len(w), expect);
+    }
+  }
+}
+
+static void test_parse_digits_k() {
+  srand(42);
+  for (int iter = 0; iter < 200000; ++iter) {
+    int k = 1 + rand() % 8;
+    char buf[8];
+    uint64_t expect = 0;
+    for (int i = 0; i < 8; ++i) {
+      int d = rand() % 10;
+      buf[i] = (char)('0' + d);
+      if (i < k) expect = expect * 10 + (uint64_t)d;
+    }
+    uint64_t w;
+    std::memcpy(&w, buf, 8);
+    CHECK_EQ_(parse_digits_k(w, k), expect);
+  }
+}
+
+static void test_load8_clamp() {
+  const char* s = "1234567";
+  uint64_t w = load8(s + 5, s + 7);  // only "67" readable
+  CHECK_EQ_(digit_run_len(w), 2);
+  CHECK_EQ_(parse_digits_k(w, 2), 67u);
+  CHECK_EQ_(load8(s + 7, s + 7), 0u);
+}
+
+// ------------------------------------------------------------- strtonum
+
+static void check_f64(const std::string& tok) {
+  double got;
+  bool ok = parse_f64(tok.data(), tok.data() + tok.size(), &got);
+  errno = 0;
+  char* end = nullptr;
+  double want = strtod(tok.c_str(), &end);
+  bool want_ok = (end == tok.c_str() + tok.size()) && !tok.empty();
+  // strtod accepts hex/inf/nan spellings and leading spaces; the engine
+  // contract matches Python float(): no hex, no leading space (those are
+  // exercised via the Python parity fuzz, not here)
+  CHECK_EQ_(ok, want_ok);
+  if (ok && want_ok) {
+    if (std::isnan(want)) {
+      CHECK_TRUE(std::isnan(got));
+    } else {
+      // bit-exact, incl. signed zero
+      uint64_t gb, wb;
+      std::memcpy(&gb, &got, 8);
+      std::memcpy(&wb, &want, 8);
+      CHECK_EQ_(gb, wb);
+    }
+  }
+}
+
+static void test_parse_f64() {
+  const char* vectors[] = {
+      "0", "-0", "+0", "1", "-1", "0.5", "-0.25", "1e3", "1E3", "1e-3",
+      "1.5e+2", "3.14159265358979", "2.2250738585072014e-308",  // min normal
+      "4.9406564584124654e-324",                                // denormal
+      "1.7976931348623157e308", "1e309", "-1e309", "1e-400",    // inf/zero
+      "9007199254740993",      // 2^53+1: exact-path rounding
+      "0.1", "0.2", "0.3",     // classic non-exact decimals
+      "123456789012345678901234567890",  // >19 digits
+      "0.00000000000000000000000000001",
+      "1.", ".5", "-.5", "+.5", ".",
+      "1e", "1e+", "e3", "", "+", "-", "+-1", "-+1", "1.2.3", "1..2",
+      "00000000000000000000001.5",  // leading zeros past 19 digits
+      "5e0000000000000000002",      // huge exponent spelling of 500
+      "65535:", "abc", "1 ",
+  };
+  for (const char* v : vectors) check_f64(v);
+  // contract divergences from strtod (golden is Python float(), which
+  // rejects hex literals and the engine never sees leading whitespace):
+  double tmp;
+  CHECK_TRUE(!parse_f64("0x10", "0x10" + 4, &tmp));
+  CHECK_TRUE(!parse_f64(" 1", " 1" + 2, &tmp));
+  // randomized round-trips of printf'd doubles at several precisions
+  srand(7);
+  char buf[64];
+  for (int i = 0; i < 50000; ++i) {
+    double x = ((double)rand() / RAND_MAX - 0.5) *
+               std::pow(10.0, rand() % 40 - 20);
+    snprintf(buf, sizeof buf, "%.*g", 1 + rand() % 17, x);
+    check_f64(buf);
+  }
+}
+
+// ------------------------------------------------------------------ Buf
+
+static void test_buf() {
+  Buf<uint32_t> a, b;
+  a.append(b);  // both empty/unallocated: must be a no-op, not UB
+  CHECK_EQ_(a.size(), (size_t)0);
+  for (uint32_t i = 0; i < 5000; ++i) a.push_back(i);
+  for (uint32_t i = 0; i < 100; ++i) b.push_back(1000000 + i);
+  a.append(b);
+  CHECK_EQ_(a.size(), (size_t)5100);
+  CHECK_EQ_(a.data()[0], 0u);
+  CHECK_EQ_(a.data()[4999], 4999u);
+  CHECK_EQ_(a.data()[5099], 1000099u);
+  a.clear();
+  CHECK_TRUE(a.empty());
+  CHECK_TRUE(a.cap >= 5100);  // capacity survives clear (arena pooling)
+}
+
+static void test_arena_widen() {
+  CSRArena a;
+  a.push_index(7);
+  a.push_index(UINT32_MAX);
+  CHECK_TRUE(!a.wide);
+  a.push_index((uint64_t)UINT32_MAX + 1);  // forces widening
+  CHECK_TRUE(a.wide);
+  CHECK_EQ_(a.nnz(), (size_t)3);
+  CHECK_EQ_(a.index64[0], (uint64_t)7);
+  CHECK_EQ_(a.index64[2], (uint64_t)UINT32_MAX + 1);
+  a.compute_index_range();
+  CHECK_EQ_(a.min_index, (uint64_t)7);
+  CHECK_EQ_(a.max_index, (uint64_t)UINT32_MAX + 1);
+}
+
+// --------------------------------------------------------- shard bounds
+
+static void test_shard_coverage() {
+  // synthetic 2-file dataset with ragged line lengths; every (nparts)
+  // partition must see each line exactly once, for any chunk size
+  std::string dir = "/tmp/dtp_engine_unittest";
+  std::system(("rm -rf " + dir + " && mkdir -p " + dir).c_str());
+  std::vector<FileEntry> files;
+  int line_no = 0;
+  srand(3);
+  for (int f = 0; f < 2; ++f) {
+    std::string path = dir + "/part" + std::to_string(f) + ".libsvm";
+    std::ofstream out(path);
+    for (int i = 0; i < 500; ++i) {
+      out << (line_no % 2) << " " << line_no << ":1";
+      for (int j = rand() % 6; j > 0; --j) out << " " << 10000 + j << ":0.5";
+      out << "\n";
+      ++line_no;
+    }
+    out.close();
+    std::ifstream sz(path, std::ios::ate | std::ios::binary);
+    files.push_back({path, (int64_t)sz.tellg()});
+  }
+  for (int nparts : {1, 3, 7}) {
+    for (int64_t chunk : {256, 4096, 1 << 20}) {
+      std::multiset<int64_t> seen;
+      for (int part = 0; part < nparts; ++part) {
+        TextShardReader r(files, part, nparts, chunk);
+        std::string chunk_buf;
+        CSRArena a;
+        while (r.NextChunk(&chunk_buf))
+          ParseLibSVMSlice(chunk_buf.data(),
+                           chunk_buf.data() + chunk_buf.size(), &a);
+        // first feature index of each row IS the global line number
+        for (size_t row = 0; row < a.rows(); ++row) {
+          int64_t lo = a.offset[row];
+          seen.insert((int64_t)a.index32.data()[lo]);
+        }
+      }
+      CHECK_EQ_(seen.size(), (size_t)line_no);
+      CHECK_EQ_(*seen.begin(), (int64_t)0);
+      CHECK_EQ_(*seen.rbegin(), (int64_t)(line_no - 1));
+      CHECK_TRUE(std::set<int64_t>(seen.begin(), seen.end()).size() ==
+                 seen.size());  // no duplicates
+    }
+  }
+}
+
+int main() {
+  test_digit_run_len();
+  test_parse_digits_k();
+  test_load8_clamp();
+  test_parse_f64();
+  test_buf();
+  test_arena_widen();
+  test_shard_coverage();
+  if (g_failures) {
+    std::cerr << g_failures << " native unit-test failures\n";
+    return 1;
+  }
+  std::cout << "all native unit tests passed\n";
+  return 0;
+}

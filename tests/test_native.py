@@ -302,3 +302,23 @@ class TestDoubleSignRejection:
         assert g.content_hash() == n.content_hash()
         assert int(g.index[0]) == big
         assert int(n.index[0]) == big
+
+
+class TestCppUnittests:
+    """Build and run the native C++ unit-test program (reference:
+    test/unittest gtest suite; see engine_unittest.cc)."""
+
+    def test_cpp_unittests(self, tmp_path):
+        from dmlc_tpu import native as native_pkg
+        src = os.path.join(os.path.dirname(native_pkg.__file__),
+                           "src", "engine_unittest.cc")
+        exe = str(tmp_path / "engine_unittest")
+        build = subprocess.run(
+            ["g++", "-O2", "-march=native", "-std=c++17", src,
+             "-o", exe, "-pthread"],
+            capture_output=True, text=True, timeout=300)
+        assert build.returncode == 0, build.stderr[-2000:]
+        run = subprocess.run([exe], capture_output=True, text=True,
+                             timeout=300)
+        assert run.returncode == 0, (run.stdout + run.stderr)[-2000:]
+        assert "all native unit tests passed" in run.stdout
