@@ -220,16 +220,27 @@ def bench_prefetch(mb: int, device: bool) -> Dict:
             rows += b.size
             if dev is not None:
                 import jax
-                in_flight.append(jax.device_put(
+                # keep the native arena leased until its transfer lands
+                lease = p.detach() if hasattr(p, "detach") else None
+                in_flight.append((jax.device_put(
                     {"offset": b.offset, "index": b.index,
-                     "value": b.value}, dev))
+                     "value": b.value}, dev), lease))
                 if len(in_flight) > 4:
-                    jax.block_until_ready(in_flight.pop(0))
+                    fut, ls = in_flight.pop(0)
+                    jax.block_until_ready(fut)
+                    if ls is not None:
+                        ls.release()
+        if dev is not None:
+            import jax
+            # drain THIS parser's in-flight transfers before destroying
+            # it (destroy frees the leased arenas under the transfer)
+            for fut, ls in in_flight:
+                jax.block_until_ready(fut)
+                if ls is not None:
+                    ls.release()
+            in_flight.clear()
         if hasattr(p, "destroy"):
             p.destroy()
-    if dev is not None:
-        import jax
-        jax.block_until_ready(in_flight)
     dt = time.perf_counter() - t0
     return {"config": "prefetch_criteo_multihost",
             "gbps": size / dt / 1e9, "bytes": size, "rows": rows,

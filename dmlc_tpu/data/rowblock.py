@@ -62,9 +62,18 @@ class Row:
 
 
 class RowBlock:
-    """Immutable CSR batch (reference: RowBlock<IndexType>)."""
+    """Immutable CSR batch (reference: RowBlock<IndexType>).
 
-    __slots__ = ("offset", "label", "weight", "qid", "field", "index", "value")
+    ``lease`` is non-None when the arrays are zero-copy views into a
+    native-engine arena (dmlc_tpu.native.bindings.BlockLease): the block
+    is then EPHEMERAL — valid until the producing parser's next
+    next()/before_first(), the reference's RowBlock lifetime contract.
+    Consumers that retain data past that point must ``copy()`` (the
+    RowBlockContainer does this automatically).
+    """
+
+    __slots__ = ("offset", "label", "weight", "qid", "field", "index",
+                 "value", "lease")
 
     def __init__(self, offset: np.ndarray, label: np.ndarray,
                  index: np.ndarray, value: Optional[np.ndarray] = None,
@@ -94,6 +103,7 @@ class RowBlock:
         self.field = None if field is None else np.asarray(field, np.int64)
         if self.field is not None:
             check_eq(len(self.field), nnz, "field length mismatch")
+        self.lease = None
 
     @property
     def size(self) -> int:
@@ -126,7 +136,7 @@ class RowBlock:
         check(0 <= begin <= end <= self.size, "bad slice range")
         base = int(self.offset[begin])
         lo, hi = base, int(self.offset[end])
-        return RowBlock(
+        out = RowBlock(
             offset=self.offset[begin:end + 1] - base,
             label=self.label[begin:end],
             index=self.index[lo:hi],
@@ -134,6 +144,19 @@ class RowBlock:
             weight=self.weight[begin:end] if self.weight is not None else None,
             qid=self.qid[begin:end] if self.qid is not None else None,
             field=self.field[lo:hi] if self.field is not None else None)
+        out.lease = self.lease  # a slice of an ephemeral block is ephemeral
+        return out
+
+    def copy(self) -> "RowBlock":
+        """Deep copy with owned arrays (detaches from any native lease)."""
+        return RowBlock(
+            offset=self.offset.copy(),
+            label=self.label.copy(),
+            index=self.index.copy(),
+            value=self.value.copy() if self.value is not None else None,
+            weight=self.weight.copy() if self.weight is not None else None,
+            qid=self.qid.copy() if self.qid is not None else None,
+            field=self.field.copy() if self.field is not None else None)
 
     def memory_cost_bytes(self) -> int:
         """Reference: RowBlock::MemCostBytes."""
@@ -257,6 +280,10 @@ class RowBlockContainer:
         n = block.size
         if n == 0:
             return
+        if block.lease is not None:
+            # ephemeral native-arena views: the container retains array
+            # references, so materialize owned copies first
+            block = block.copy()
         self._flush_slabs()
         off = np.asarray(block.offset, np.int64)
         self._c_len.append(off[1:] - off[:-1])

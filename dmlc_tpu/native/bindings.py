@@ -43,6 +43,7 @@ def load(path: str):
     lib.dtp_parser_next.restype = C.c_int64
     lib.dtp_parser_next.argtypes = [
         C.c_void_p,
+        C.POINTER(C.c_void_p),              # block lease handle
         C.POINTER(C.POINTER(C.c_int64)),    # offset
         C.POINTER(C.POINTER(C.c_float)),    # label
         C.POINTER(C.POINTER(C.c_float)),    # weight
@@ -55,6 +56,9 @@ def load(path: str):
         C.POINTER(C.c_int), C.POINTER(C.c_int), C.POINTER(C.c_int),
     ]
     lib.dtp_parser_before_first.argtypes = [C.c_void_p]
+    lib.dtp_block_release.argtypes = [C.c_void_p, C.c_void_p]
+    lib.dtp_parser_stats.argtypes = [C.c_void_p, C.POINTER(C.c_int64)]
+    lib.dtp_parser_set_test_delay_ms.argtypes = [C.c_void_p, C.c_int]
     lib.dtp_parser_bytes_read.restype = C.c_int64
     lib.dtp_parser_bytes_read.argtypes = [C.c_void_p]
     lib.dtp_parser_total_size.restype = C.c_int64
@@ -85,8 +89,40 @@ def native_parse_float32(token: bytes) -> np.float32:
     return np.float32(out.value)
 
 
+class BlockLease:
+    """Keeps one native CSR arena alive. The RowBlock handed out by
+    ``NativeTextParser.value()`` is a ZERO-COPY view into this arena;
+    ``release()`` returns the arena to the engine's pool (after which the
+    views must not be touched). The parser auto-releases the previous
+    block on each ``next()`` — the reference's RowBlock lifetime contract
+    (include/dmlc/data.h: valid until the next Next()) — unless the
+    consumer takes it over with ``parser.detach()`` to overlap e.g. an
+    async device_put with further parsing."""
+
+    __slots__ = ("_parser", "_ptr")
+
+    def __init__(self, parser: "NativeTextParser", ptr: int):
+        self._parser = parser
+        self._ptr = ptr
+
+    def release(self) -> None:
+        ptr, self._ptr = self._ptr, None
+        parser = self._parser
+        if ptr and parser is not None and getattr(parser, "_handle", None):
+            parser._lib.dtp_block_release(parser._handle, ptr)
+        self._parser = None
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
 class NativeTextParser(Parser):
-    """Parser over the native pipeline (reader + parse threads in C++)."""
+    """Parser over the native pipeline (reader + parse-pool threads in
+    C++). Blocks are zero-copy views into engine arenas (see BlockLease).
+    """
 
     _format = "libsvm"
 
@@ -124,6 +160,7 @@ class NativeTextParser(Parser):
                 f"native parser create failed: "
                 f"{lib.dtp_last_error().decode()}")
         self._block: Optional[RowBlock] = None
+        self._lease: Optional[BlockLease] = None
 
     # format knobs; subclasses override
     _indexing_mode = 0
@@ -144,10 +181,17 @@ class NativeTextParser(Parser):
         return None
 
     def before_first(self) -> None:
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
         self._lib.dtp_parser_before_first(self._handle)
         self._block = None
 
     def next(self) -> bool:
+        if self._lease is not None:  # standard RowBlock lifetime contract
+            self._lease.release()
+            self._lease = None
+        block = C.c_void_p()
         offset = C.POINTER(C.c_int64)()
         label = C.POINTER(C.c_float)()
         weight = C.POINTER(C.c_float)()
@@ -159,49 +203,80 @@ class NativeTextParser(Parser):
         nnz = C.c_int64()
         hw, hq, hf = C.c_int(), C.c_int(), C.c_int()
         rows = self._lib.dtp_parser_next(
-            self._handle, C.byref(offset), C.byref(label), C.byref(weight),
-            C.byref(qid), C.byref(index32), C.byref(index64), C.byref(value),
-            C.byref(field), C.byref(nnz), C.byref(hw), C.byref(hq),
-            C.byref(hf))
+            self._handle, C.byref(block), C.byref(offset), C.byref(label),
+            C.byref(weight), C.byref(qid), C.byref(index32), C.byref(index64),
+            C.byref(value), C.byref(field), C.byref(nnz), C.byref(hw),
+            C.byref(hq), C.byref(hf))
         if rows < 0:
+            self._block = None  # stale views must not outlive the error
             raise DMLCError(
                 f"{self._format}: {self._lib.dtp_last_error().decode()}")
         if rows == 0:
             self._block = None
             return False
         n, z = int(rows), int(nnz.value)
+        lease = BlockLease(self, block.value)
 
         def arr(ptr, count, dtype):
+            # zero-copy view into the leased arena (no astype round-trip)
             if count == 0:
                 return np.empty(0, dtype)
-            return np.ctypeslib.as_array(ptr, shape=(count,)).astype(
-                dtype, copy=True)
+            return np.ctypeslib.as_array(ptr, shape=(count,))
 
         if index32:
             index = arr(index32, z, np.uint32)
         else:
             index = arr(index64, z, np.uint64)
-        if self.index_dtype == np.uint64:
-            index = index.astype(np.uint64, copy=False)
+        if self.index_dtype != index.dtype:
+            index = index.astype(self.index_dtype)  # widen requested u64
         self._block = RowBlock(
             offset=arr(offset, n + 1, np.int64),
             label=arr(label, n, np.float32),
-            index=index.astype(self.index_dtype, copy=False),
+            index=index,
             value=arr(value, z, np.float32),
             weight=arr(weight, n, np.float32) if hw.value else None,
             qid=arr(qid, n, np.int64) if hq.value else None,
             field=arr(field, z, np.int64) if hf.value else None)
+        self._block.lease = lease
+        self._lease = lease
         return True
 
     def value(self) -> RowBlock:
         check(self._block is not None, "value() before successful next()")
         return self._block
 
+    def detach(self) -> Optional[BlockLease]:
+        """Take ownership of the current block's lease: the parser will
+        NOT release it on the next next()/before_first(). The caller must
+        call ``lease.release()`` (e.g. after jax.block_until_ready on an
+        async device transfer of the block's views)."""
+        lease, self._lease = self._lease, None
+        return lease
+
+    def stats(self) -> Dict[str, int]:
+        """Pipeline stage timings of the current/last run (ns): reader
+        busy, parse busy (summed over workers), wall, plus chunk count
+        and queue depths. reader+parse > wall proves stage overlap."""
+        out = (C.c_int64 * 6)()
+        self._lib.dtp_parser_stats(self._handle, out)
+        return {"reader_busy_ns": int(out[0]), "parse_busy_ns": int(out[1]),
+                "wall_ns": int(out[2]), "chunks": int(out[3]),
+                "max_chunk_queue_depth": int(out[4]),
+                "max_reorder_depth": int(out[5])}
+
+    def set_test_delay_ms(self, ms: int) -> None:
+        """Test hook: add a per-chunk parse delay (pipeline-scaling proof
+        on single-core CI hosts; see tests/test_native.py)."""
+        self._lib.dtp_parser_set_test_delay_ms(self._handle, int(ms))
+
     def bytes_read(self) -> int:
         return int(self._lib.dtp_parser_bytes_read(self._handle))
 
     def destroy(self) -> None:
         if getattr(self, "_handle", None):
+            if self._lease is not None:
+                self._lease.release()
+                self._lease = None
             self._lib.dtp_parser_destroy(self._handle)
             self._handle = None
 

@@ -17,12 +17,14 @@
 //   text record  = maximal run of bytes with no '\n'/'\r'
 //   whitespace   = ' ' or '\t' between tokens (locale-free)
 //
-// C ABI (ctypes): every entry point is extern "C"; blocks are owned by the
-// handle and valid until the next dtp_parser_next/destroy call.
+// C ABI (ctypes): every entry point is extern "C"; blocks are leases —
+// owned by the handle, valid until dtp_block_release or destroy, so the
+// Python side wraps them zero-copy and overlaps transfers with parse.
 
 #include <algorithm>
 #include <atomic>
 #include <charconv>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -34,6 +36,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <type_traits>
 #include <vector>
 
 namespace {
@@ -289,6 +292,8 @@ inline bool is_nl(char c) { return c == '\n' || c == '\r'; }
 // cold paths.
 template <typename T>
 struct Buf {
+  static_assert(std::is_trivially_copyable<T>::value,
+                "Buf skips constructors; element type must be POD");
   std::unique_ptr<T[]> d;
   size_t n = 0, cap = 0;
 
@@ -458,7 +463,9 @@ class TextShardReader {
   int64_t total_size() const { return total_; }
   int64_t bytes_read() const { return bytes_read_; }
 
-  // Next buffer of whole records; false at end of shard.
+  // Next buffer of whole records; false at end of shard. Builds into
+  // *out in place so a pooled buffer keeps its capacity across chunks
+  // (the pipeline recycles chunk buffers to avoid 8MB malloc churn).
   bool NextChunk(std::string* out) {
     out->clear();
     while (true) {
@@ -466,14 +473,16 @@ class TextShardReader {
       if (!fp_ && cur_ < end_) OpenAt(cur_);
       int64_t want = std::min<int64_t>(
           chunk_bytes_, std::min(file_end_ - cur_, end_ - cur_));
-      // read directly after the carried partial record — no concat copy
-      std::string combined = std::move(leftover_);
+      // read directly after the carried partial record — swap, not copy
+      // (a record longer than chunk_bytes would otherwise re-copy the
+      // whole accumulated prefix each pass: O(n^2))
+      std::swap(*out, leftover_);
       leftover_.clear();
-      size_t head = combined.size();
+      size_t head = out->size();
       if (want > 0) {
-        combined.resize(head + (size_t)want);
-        size_t got = fread(combined.data() + head, 1, (size_t)want, fp_);
-        combined.resize(head + got);
+        out->resize(head + (size_t)want);
+        size_t got = fread(out->data() + head, 1, (size_t)want, fp_);
+        out->resize(head + got);
         bytes_read_ += (int64_t)got;
         cur_ += (int64_t)got;
         // the VFS listing promised more bytes: a zero read here means the
@@ -487,21 +496,18 @@ class TextShardReader {
       if (at_file_end) {
         CloseFile();
         if (cur_ >= end_) cur_ = end_;
-        if (!combined.empty()) {
-          *out = std::move(combined);
-          return true;
-        }
+        if (!out->empty()) return true;
         continue;
       }
       // cut at last newline; carry the partial tail
-      size_t cut = combined.find_last_of("\n\r");
+      size_t cut = out->find_last_of("\n\r");
       if (cut == std::string::npos) {
-        leftover_ = std::move(combined);
+        std::swap(leftover_, *out);
+        out->clear();
         continue;
       }
-      leftover_ = combined.substr(cut + 1);
-      combined.resize(cut + 1);
-      *out = std::move(combined);
+      leftover_.assign(*out, cut + 1, std::string::npos);
+      out->resize(cut + 1);
       return true;
     }
   }
@@ -849,70 +855,47 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
   }
 }
 
-// Split a chunk at record boundaries into ~nslices and parse in the
-// calling thread pool slot; slices stitched in order (reference:
-// TextParserBase OpenMP ParseBlock + FillData stitch). Slice 0 parses
-// directly into *out (typically a pooled arena with warm capacity).
-void ParseChunk(const std::string& chunk, const ParserConfig& cfg,
-                std::atomic<long>* ncol_atom, int nslices, CSRArena* out) {
+// Parse one whole chunk into one arena on the calling worker thread.
+// Parallelism is chunk-granular (each pool worker owns a whole chunk),
+// so there is no slice stitch and no cross-thread append copy at all —
+// unlike the reference's OpenMP ParseBlock + FillData stitch
+// (src/data/text_parser.h), which pays a full extra pass to merge
+// per-thread containers. Chunks are already cut at record boundaries
+// by TextShardReader, and the ordered output queue restores chunk
+// order, so output stays byte-identical at any thread count.
+void ParseChunkInto(const std::string& chunk, const ParserConfig& cfg,
+                    std::atomic<long>* ncol_atom, CSRArena* out) {
   const char* b = chunk.data();
   const char* e = b + chunk.size();
-  std::vector<std::pair<const char*, const char*>> slices;
-  if (nslices <= 1 || chunk.size() < (size_t)(64 << 10)) {
-    slices.emplace_back(b, e);
-  } else {
-    size_t step = chunk.size() / nslices;
-    const char* s = b;
-    for (int i = 1; i < nslices && s < e; ++i) {
-      const char* cut = b + step * i;
-      if (cut <= s) continue;
-      while (cut < e && !is_nl(*cut)) ++cut;
-      while (cut < e && is_nl(*cut)) ++cut;
-      slices.emplace_back(s, cut);
-      s = cut;
-    }
-    if (s < e) slices.emplace_back(s, e);
+  switch (cfg.format) {
+    case Format::kLibSVM:
+      ParseLibSVMSlice(b, e, out);
+      break;
+    case Format::kCSV:
+      ParseCSVSlice(b, e, cfg, ncol_atom, out);
+      break;
+    case Format::kLibFM:
+      ParseLibFMSlice(b, e, out);
+      break;
   }
-  std::vector<CSRArena> parts(slices.size() > 1 ? slices.size() - 1 : 0);
-  std::vector<std::string> errors(slices.size());
-  std::vector<std::thread> threads;
-  auto work = [&](size_t i) {
-    CSRArena* dst = (i == 0) ? out : &parts[i - 1];
-    try {
-      switch (cfg.format) {
-        case Format::kLibSVM:
-          ParseLibSVMSlice(slices[i].first, slices[i].second, dst);
-          break;
-        case Format::kCSV:
-          ParseCSVSlice(slices[i].first, slices[i].second, cfg, ncol_atom,
-                        dst);
-          break;
-        case Format::kLibFM:
-          ParseLibFMSlice(slices[i].first, slices[i].second, dst);
-          break;
-      }
-    } catch (const EngineError& err) {
-      errors[i] = err.msg;
-    }
-  };
-  if (slices.size() == 1) {
-    work(0);
-  } else {
-    for (size_t i = 1; i < slices.size(); ++i)
-      threads.emplace_back(work, i);
-    work(0);
-    for (auto& t : threads) t.join();
-  }
-  for (auto& err : errors)
-    if (!err.empty()) throw EngineError{err};
-  for (auto& part : parts) out->append(std::move(part));
   if (cfg.format != Format::kCSV) out->compute_index_range();
 }
 
 // ------------------------------------------------------------- pipeline
-// reader thread -> chunk queue -> parser threads -> ordered block queue
-// (reference: ThreadedInputSplit + ThreadedIter; exceptions propagate to
-// the consumer's next(), reference unittest_threaditer_exc_handling).
+// reader thread -> bounded chunk queue -> persistent parser pool (N
+// threads, one whole chunk per worker) -> ordered reorder window ->
+// consumer. IO overlaps parse (the reader is never behind a parse), and
+// up to `window` chunks are in flight through parse at once. Output
+// order is chunk order, so bytes are identical at any thread count.
+// (reference seam: ThreadedInputSplit's prefetch thread + text_parser.h's
+// OMP fan-out + threadediter.h's exception propagation — redesigned as a
+// persistent pool with a reorder window instead of per-chunk fork/join.)
+
+inline int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 template <typename T>
 class BoundedQueue {
@@ -924,6 +907,7 @@ class BoundedQueue {
     cv_full_.wait(lk, [&] { return q_.size() < cap_ || killed_; });
     if (killed_) return false;
     q_.push_back(std::move(v));
+    max_depth_ = std::max(max_depth_, q_.size());
     cv_empty_.notify_one();
     return true;
   }
@@ -931,6 +915,7 @@ class BoundedQueue {
   bool Pop(T* out) {  // false if killed or finished-and-empty
     std::unique_lock<std::mutex> lk(mu_);
     cv_empty_.wait(lk, [&] { return !q_.empty() || killed_ || finished_; });
+    if (killed_) return false;
     if (!q_.empty()) {
       *out = std::move(q_.front());
       q_.pop_front();
@@ -953,11 +938,9 @@ class BoundedQueue {
     cv_full_.notify_all();
   }
 
-  void Reset() {
+  size_t max_depth() {
     std::lock_guard<std::mutex> lk(mu_);
-    q_.clear();
-    killed_ = false;
-    finished_ = false;
+    return max_depth_;
   }
 
  private:
@@ -965,29 +948,127 @@ class BoundedQueue {
   std::condition_variable cv_empty_, cv_full_;
   std::deque<T> q_;
   size_t cap_;
+  size_t max_depth_ = 0;
   bool killed_ = false, finished_ = false;
+};
+
+struct ChunkItem {
+  uint64_t seq = 0;
+  std::string data;
+};
+
+struct BlockItem {
+  std::unique_ptr<CSRArena> arena;  // null => error at this position
+  std::string error;
+};
+
+// Emits blocks in seq order. Producers (parser workers + the reader's
+// error slot) push out of order; Push blocks while seq is more than
+// `window` ahead of the next emission, bounding in-flight arenas.
+class OrderedQueue {
+ public:
+  OrderedQueue(size_t window, int producers)
+      : window_(window), producers_(producers) {}
+
+  bool Push(uint64_t seq, BlockItem&& item) {  // false if killed
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_space_.wait(lk, [&] { return killed_ || seq < next_ + window_; });
+    if (killed_) return false;
+    held_.emplace(seq, std::move(item));
+    max_depth_ = std::max(max_depth_, held_.size());
+    if (held_.count(next_)) cv_ready_.notify_all();
+    return true;
+  }
+
+  // false => killed, or all producers done with nothing pending
+  bool Pop(BlockItem* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_ready_.wait(lk, [&] {
+      return killed_ || held_.count(next_) ||
+             (producers_ == 0 && held_.empty());
+    });
+    if (killed_) return false;
+    auto it = held_.find(next_);
+    if (it == held_.end()) return false;  // finished
+    *out = std::move(it->second);
+    held_.erase(it);
+    ++next_;
+    cv_space_.notify_all();
+    cv_ready_.notify_all();  // the next seq may already be waiting
+    return true;
+  }
+
+  void ProducerDone() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (--producers_ == 0) cv_ready_.notify_all();
+  }
+
+  void Kill() {
+    std::lock_guard<std::mutex> lk(mu_);
+    killed_ = true;
+    cv_ready_.notify_all();
+    cv_space_.notify_all();
+  }
+
+  size_t max_depth() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return max_depth_;
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_ready_, cv_space_;
+  std::map<uint64_t, BlockItem> held_;
+  uint64_t next_ = 0;
+  size_t window_;
+  int producers_;
+  bool killed_ = false;
+  size_t max_depth_ = 0;
+};
+
+struct PipelineStats {
+  std::atomic<int64_t> reader_busy_ns{0};   // time inside NextChunk
+  std::atomic<int64_t> parse_busy_ns{0};    // summed across workers
+  std::atomic<int64_t> chunks{0};
+  int64_t start_ns = now_ns();  // sane wall even before the first run
+  std::atomic<int64_t> end_ns{0};           // set at end (incl. error)
+
+  void Reset() {
+    reader_busy_ns = 0;
+    parse_busy_ns = 0;
+    chunks = 0;
+    start_ns = now_ns();
+    end_ns = 0;
+  }
 };
 
 struct ParserHandle {
   ParserConfig cfg;
   std::unique_ptr<TextShardReader> reader;
   int nthreads = 1;
+  int test_delay_ms = 0;  // test hook: per-chunk parse delay (scaling proof)
 
   // pipeline state (rebuilt on BeforeFirst)
-  std::unique_ptr<std::thread> worker;
-  std::unique_ptr<BoundedQueue<std::pair<std::unique_ptr<CSRArena>,
-                                         std::string>>> blocks;
-  std::unique_ptr<CSRArena> current;        // block handed to consumer
+  std::unique_ptr<std::thread> reader_thread;
+  std::vector<std::thread> pool;
+  std::unique_ptr<BoundedQueue<ChunkItem>> chunks;
+  std::unique_ptr<OrderedQueue> blocks;
   std::atomic<long> ncol{-1};
   int resolved_mode = 0;
   bool mode_resolved = false;
   std::string error;
+  PipelineStats stats;
+  size_t max_chunk_depth = 0, max_reorder_depth = 0;  // of last run
 
-  // arena free-list shared between the worker (producer) and Next()
-  // (consumer recycles the previous current block) — bounds live arenas
-  // to queue capacity + pool without per-chunk large malloc/free
+  // free-lists: arenas (CSR output) and chunk buffers (reader strings),
+  // bounding live memory to the pipeline window without per-chunk
+  // large malloc/munmap + page-fault churn
   std::mutex pool_mu;
   std::vector<std::unique_ptr<CSRArena>> arena_pool;
+  std::vector<std::string> chunk_pool;
+  // blocks handed to the consumer stay valid until released (zero-copy
+  // at the ABI; bindings release the previous block on the next next())
+  std::map<CSRArena*, std::unique_ptr<CSRArena>> outstanding;
 
   std::unique_ptr<CSRArena> GetArena() {
     {
@@ -1008,50 +1089,109 @@ struct ParserHandle {
     arena_pool.push_back(std::move(a));
   }
 
+  std::string GetChunkBuf() {
+    std::lock_guard<std::mutex> lk(pool_mu);
+    if (!chunk_pool.empty()) {
+      std::string s = std::move(chunk_pool.back());
+      chunk_pool.pop_back();
+      return s;
+    }
+    return std::string();
+  }
+
+  void RecycleChunkBuf(std::string&& s) {
+    std::lock_guard<std::mutex> lk(pool_mu);
+    if (chunk_pool.size() < (size_t)(nthreads + 4))
+      chunk_pool.push_back(std::move(s));
+  }
+
   ~ParserHandle() { StopPipeline(); }
 
   void StopPipeline() {
+    if (chunks) chunks->Kill();
     if (blocks) blocks->Kill();
-    if (worker && worker->joinable()) worker->join();
-    worker.reset();
+    if (reader_thread && reader_thread->joinable()) reader_thread->join();
+    for (auto& t : pool)
+      if (t.joinable()) t.join();
+    pool.clear();
+    reader_thread.reset();
+    chunks.reset();
     blocks.reset();
   }
 
   void StartPipeline() {
     StopPipeline();
     reader->Reset();
-    blocks = std::make_unique<BoundedQueue<
-        std::pair<std::unique_ptr<CSRArena>, std::string>>>(4);
-    worker = std::make_unique<std::thread>([this] {
+    stats.Reset();
+    size_t window = (size_t)nthreads + 2;
+    chunks = std::make_unique<BoundedQueue<ChunkItem>>(window);
+    // producers = nthreads workers + the reader (for its error slot)
+    blocks = std::make_unique<OrderedQueue>(window, nthreads + 1);
+
+    reader_thread = std::make_unique<std::thread>([this] {
+      uint64_t seq = 0;
       try {
-        std::string chunk;
-        while (reader->NextChunk(&chunk)) {
-          auto arena = GetArena();
-          ParseChunk(chunk, cfg, &ncol, nthreads, arena.get());
-          if (!blocks->Push({std::move(arena), std::string()})) return;
+        while (true) {
+          ChunkItem item;
+          item.data = GetChunkBuf();
+          int64_t t0 = now_ns();
+          bool more = reader->NextChunk(&item.data);
+          stats.reader_busy_ns += now_ns() - t0;
+          if (!more) break;
+          item.seq = seq++;
+          stats.chunks += 1;
+          if (!chunks->Push(std::move(item))) break;
         }
-        blocks->Finish();
+        chunks->Finish();
       } catch (const EngineError& err) {
-        blocks->Push({nullptr, err.msg});
-        blocks->Finish();
+        chunks->Finish();
+        blocks->Push(seq, {nullptr, err.msg});
       } catch (const std::exception& ex) {
-        blocks->Push({nullptr, std::string(ex.what())});
-        blocks->Finish();
+        chunks->Finish();
+        blocks->Push(seq, {nullptr, std::string(ex.what())});
       }
+      blocks->ProducerDone();
     });
+
+    for (int w = 0; w < nthreads; ++w) {
+      pool.emplace_back([this] {
+        ChunkItem item;
+        while (chunks->Pop(&item)) {
+          BlockItem out;
+          int64_t t0 = now_ns();
+          if (test_delay_ms > 0)
+            std::this_thread::sleep_for(
+                std::chrono::milliseconds(test_delay_ms));
+          try {
+            auto arena = GetArena();
+            ParseChunkInto(item.data, cfg, &ncol, arena.get());
+            out.arena = std::move(arena);
+          } catch (const EngineError& err) {
+            out.error = err.msg;
+          } catch (const std::exception& ex) {
+            out.error = ex.what();
+          }
+          stats.parse_busy_ns += now_ns() - t0;
+          RecycleChunkBuf(std::move(item.data));
+          if (!blocks->Push(item.seq, std::move(out))) break;
+        }
+        blocks->ProducerDone();
+      });
+    }
   }
 
   // returns rows; 0 = end; -1 = error (message in this->error)
   int64_t Next() {
     if (!blocks) StartPipeline();
-    RecycleArena(std::move(current));  // consumer is done with it
-    std::pair<std::unique_ptr<CSRArena>, std::string> item;
+    BlockItem item;
     while (blocks->Pop(&item)) {
-      if (!item.first) {
-        error = item.second;
+      if (!item.arena) {
+        error = item.error;
+        last = nullptr;
+        stats.end_ns = now_ns();  // error ends the run's wall clock too
         return -1;
       }
-      std::unique_ptr<CSRArena> a = std::move(item.first);
+      std::unique_ptr<CSRArena> a = std::move(item.arena);
       if (!mode_resolved) {
         if (cfg.indexing_mode == -1)
           resolved_mode =
@@ -1068,7 +1208,9 @@ struct ParserHandle {
         if (a->wide)
           for (auto& ix : a->index64) ix -= 1;
         else
-          for (auto& ix : a->index32) ix -= 1;
+          for (uint32_t* ix = a->index32.begin(); ix != a->index32.end();
+               ++ix)
+            *ix -= 1;
         if (a->nnz()) {
           a->min_index -= 1;
           a->max_index -= 1;
@@ -1078,10 +1220,35 @@ struct ParserHandle {
         RecycleArena(std::move(a));
         continue;
       }
-      current = std::move(a);
-      return (int64_t)current->rows();
+      CSRArena* raw = a.get();
+      {
+        std::lock_guard<std::mutex> lk(pool_mu);
+        outstanding[raw] = std::move(a);
+      }
+      last = raw;
+      return (int64_t)raw->rows();
     }
+    last = nullptr;
+    stats.end_ns = now_ns();
+    max_chunk_depth = chunks ? chunks->max_depth() : 0;
+    max_reorder_depth = blocks ? blocks->max_depth() : 0;
     return 0;
+  }
+
+  // the block most recently handed out by Next() (ABI pointer source);
+  // guarded access not needed: set/read only under the consumer's call
+  CSRArena* last = nullptr;
+
+  void Release(CSRArena* block) {
+    std::unique_ptr<CSRArena> a;
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      auto it = outstanding.find(block);
+      if (it == outstanding.end()) return;
+      a = std::move(it->second);
+      outstanding.erase(it);
+      arena_pool.push_back(std::move(a));
+    }
   }
 };
 
@@ -1103,7 +1270,7 @@ extern "C" {
 
 const char* dtp_last_error() { return g_last_error.c_str(); }
 
-int dtp_version() { return 1; }
+int dtp_version() { return 2; }
 
 // files: paths array; sizes must match the Python VFS listing so the
 // shard contract is identical across engines.
@@ -1134,8 +1301,13 @@ void* dtp_parser_create(const char** paths, const int64_t* sizes,
 }
 
 // Pull next block. Returns rows (>0), 0 at end, -1 on error
-// (dtp_last_error). Pointers valid until the next call on this handle.
-int64_t dtp_parser_next(void* handle, const int64_t** offset,
+// (dtp_last_error). *block_out receives an opaque lease handle; the
+// returned pointers are views into it and stay valid until
+// dtp_block_release(handle, block) or dtp_parser_destroy — NOT merely
+// until the next call, so consumers can overlap device transfers of
+// block N with parsing of N+1 (zero-copy at the ABI).
+int64_t dtp_parser_next(void* handle, void** block_out,
+                        const int64_t** offset,
                         const float** label, const float** weight,
                         const int64_t** qid, const uint32_t** index32,
                         const uint64_t** index64, const float** value,
@@ -1148,7 +1320,8 @@ int64_t dtp_parser_next(void* handle, const int64_t** offset,
     return -1;
   }
   if (rows == 0) return 0;
-  CSRArena* a = h->current.get();
+  CSRArena* a = h->last;
+  *block_out = a;
   *offset = a->offset.data();
   *label = a->label.data();
   *weight = a->weight.data();
@@ -1176,8 +1349,41 @@ void dtp_parser_before_first(void* handle) {
   h->StopPipeline();
   h->ncol.store(-1);
   h->mode_resolved = false;
-  h->current.reset();
+  h->last = nullptr;
+  // outstanding blocks stay valid across epochs until released;
   // pipeline restarts lazily on next()
+}
+
+// Return a block's arena to the pool (see dtp_parser_next contract).
+void dtp_block_release(void* handle, void* block) {
+  if (!handle || !block) return;
+  static_cast<ParserHandle*>(handle)->Release(
+      static_cast<CSRArena*>(block));
+}
+
+// Stage timings + pipeline shape of the current/last run. out[6]:
+// [reader_busy_ns, parse_busy_ns (summed over workers), wall_ns,
+//  chunks, max_chunk_queue_depth, max_reorder_depth]
+// reader_busy + parse_busy > wall proves IO/parse (or parse/parse)
+// overlap; parse_busy/wall ~ N proves N-way parse scaling.
+void dtp_parser_stats(void* handle, int64_t* out) {
+  auto* h = static_cast<ParserHandle*>(handle);
+  out[0] = h->stats.reader_busy_ns.load();
+  out[1] = h->stats.parse_busy_ns.load();
+  int64_t end = h->stats.end_ns.load();
+  out[2] = (end ? end : now_ns()) - h->stats.start_ns;
+  out[3] = h->stats.chunks.load();
+  out[4] = (int64_t)(h->chunks ? h->chunks->max_depth()
+                               : h->max_chunk_depth);
+  out[5] = (int64_t)(h->blocks ? h->blocks->max_depth()
+                               : h->max_reorder_depth);
+}
+
+// Test hook: make every chunk "parse" take >= ms extra. Lets a 1-core
+// CI host prove the pipeline imposes no serialization beyond the work
+// itself: with N workers and M chunks of delay T, wall ~ ceil(M/N)*T.
+void dtp_parser_set_test_delay_ms(void* handle, int ms) {
+  static_cast<ParserHandle*>(handle)->test_delay_ms = ms;
 }
 
 int64_t dtp_parser_bytes_read(void* handle) {

@@ -24,6 +24,12 @@ def device_prefetch(host_batches: Iterable[Dict[str, Any]], size: int = 2,
     ``sharding`` may be a jax.sharding.Sharding (multi-device placement) or
     None (default device). Structure of each batch (dict/pytree of numpy
     arrays) is preserved.
+
+    Batches must own their buffers (or stay leased) until their transfer
+    completes: up to ``size`` device_puts are in flight while the source
+    iterator advances. Ephemeral native-parser views (RowBlock.lease set)
+    must be copied or lease-detached by the producing iterator —
+    ShardedRowBlockIter's pad_to_bucket does this by construction.
     """
     queue: collections.deque = collections.deque()
 

@@ -23,7 +23,10 @@ def drain(parser):
     blocks = []
     parser.before_first()
     while parser.next():
-        blocks.append(parser.value())
+        v = parser.value()
+        # native blocks are zero-copy views valid until the next next();
+        # retaining them across calls requires a copy (the contract)
+        blocks.append(v.copy() if v.lease is not None else v)
     return blocks
 
 
