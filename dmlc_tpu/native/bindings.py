@@ -22,7 +22,8 @@ from dmlc_tpu.io.uri_spec import URISpec
 from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
-           "NativeCSVParser", "NativeLibFMParser", "native_parse_float32"]
+           "NativeCSVParser", "NativeLibFMParser", "NativeRecordIOReader",
+           "native_parse_float32"]
 
 _lib = None
 
@@ -64,6 +65,25 @@ def load(path: str):
     lib.dtp_parser_total_size.restype = C.c_int64
     lib.dtp_parser_total_size.argtypes = [C.c_void_p]
     lib.dtp_parser_destroy.argtypes = [C.c_void_p]
+    lib.dtp_recio_create.restype = C.c_void_p
+    lib.dtp_recio_create.argtypes = [
+        C.POINTER(C.c_char_p), C.POINTER(C.c_int64), C.c_int64, C.c_int64,
+        C.c_int64, C.c_int64,
+    ]
+    lib.dtp_recio_next_batch.restype = C.c_int64
+    lib.dtp_recio_next_batch.argtypes = [
+        C.c_void_p, C.POINTER(C.c_void_p),
+        C.POINTER(C.POINTER(C.c_uint8)), C.POINTER(C.POINTER(C.c_int64)),
+        C.POINTER(C.POINTER(C.c_int64)),
+    ]
+    lib.dtp_recio_block_release.argtypes = [C.c_void_p, C.c_void_p]
+    lib.dtp_recio_before_first.argtypes = [C.c_void_p]
+    lib.dtp_recio_bytes_read.restype = C.c_int64
+    lib.dtp_recio_bytes_read.argtypes = [C.c_void_p]
+    lib.dtp_recio_total_size.restype = C.c_int64
+    lib.dtp_recio_total_size.argtypes = [C.c_void_p]
+    lib.dtp_recio_stats.argtypes = [C.c_void_p, C.POINTER(C.c_int64)]
+    lib.dtp_recio_destroy.argtypes = [C.c_void_p]
     lib.dtp_parse_float32.restype = C.c_int
     lib.dtp_parse_float32.argtypes = [C.c_char_p, C.c_int64,
                                       C.POINTER(C.c_float)]
@@ -90,27 +110,29 @@ def native_parse_float32(token: bytes) -> np.float32:
 
 
 class BlockLease:
-    """Keeps one native CSR arena alive. The RowBlock handed out by
-    ``NativeTextParser.value()`` is a ZERO-COPY view into this arena;
-    ``release()`` returns the arena to the engine's pool (after which the
-    views must not be touched). The parser auto-releases the previous
-    block on each ``next()`` — the reference's RowBlock lifetime contract
+    """Keeps one native engine block (CSR arena or record batch) alive.
+    The arrays handed out by the producing reader are ZERO-COPY views
+    into it; ``release()`` returns it to the engine's pool (after which
+    the views must not be touched). Producers auto-release the previous
+    block on each next() — the reference's RowBlock lifetime contract
     (include/dmlc/data.h: valid until the next Next()) — unless the
-    consumer takes it over with ``parser.detach()`` to overlap e.g. an
+    consumer takes the lease over with ``detach()`` to overlap e.g. an
     async device_put with further parsing."""
 
-    __slots__ = ("_parser", "_ptr")
+    __slots__ = ("_owner", "_ptr")
 
-    def __init__(self, parser: "NativeTextParser", ptr: int):
-        self._parser = parser
+    _release_fn = "dtp_block_release"  # C release entry point
+
+    def __init__(self, owner, ptr: int):
+        self._owner = owner
         self._ptr = ptr
 
     def release(self) -> None:
         ptr, self._ptr = self._ptr, None
-        parser = self._parser
-        if ptr and parser is not None and getattr(parser, "_handle", None):
-            parser._lib.dtp_block_release(parser._handle, ptr)
-        self._parser = None
+        owner = self._owner
+        if ptr and owner is not None and getattr(owner, "_handle", None):
+            getattr(owner._lib, self._release_fn)(owner._handle, ptr)
+        self._owner = None
 
     def __del__(self):
         try:
@@ -278,6 +300,122 @@ class NativeTextParser(Parser):
                 self._lease.release()
                 self._lease = None
             self._lib.dtp_parser_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+class _RecioLease(BlockLease):
+    """BlockLease for record batches (different C release entry)."""
+
+    __slots__ = ()
+
+    _release_fn = "dtp_recio_block_release"
+
+
+class NativeRecordIOReader:
+    """Sharded RecordIO record reader over the native pipeline.
+
+    Native counterpart of InputSplit.create(uri, k, n, "recordio")
+    (reference: src/io/recordio_split.cc + src/recordio.cc): the engine's
+    reader thread realigns the shard to a record-starting frame head,
+    reads whole-frame chunks, and the decode stitches multi-frame
+    records IN PLACE inside the chunk buffer (single-frame records never
+    move — decode cost is the header walk). ``next_batch()`` yields one
+    chunk's records zero-copy as (payload_u8, starts_i64, ends_i64)
+    numpy views — record i is ``payload[starts[i]:ends[i]]`` — valid
+    until the next next_batch()/before_first() (or hold via
+    ``detach()``). Record stream is byte-identical to the Python split
+    (parity test: tests/test_native.py)."""
+
+    def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1,
+                 chunk_size: int = 8 << 20):
+        lib = _get_lib()
+        self.uri = uri
+        files = list_split_files(uri)
+        for p, _ in files:
+            check(os.path.exists(p),
+                  f"native recordio requires local files, got {p!r}")
+        paths = (C.c_char_p * len(files))(*[p.encode() for p, _ in files])
+        sizes = (C.c_int64 * len(files))(*[s for _, s in files])
+        self._lib = lib
+        self._handle = lib.dtp_recio_create(
+            paths, sizes, len(files), part_index, num_parts,
+            int(chunk_size))
+        if not self._handle:
+            raise DMLCError(f"native recordio create failed: "
+                            f"{lib.dtp_last_error().decode()}")
+        self._lease: Optional[_RecioLease] = None
+
+    def next_batch(self):
+        """(payload, starts, ends) numpy views for one chunk's records,
+        or None at end of shard."""
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
+        block = C.c_void_p()
+        payload = C.POINTER(C.c_uint8)()
+        starts = C.POINTER(C.c_int64)()
+        ends = C.POINTER(C.c_int64)()
+        nrec = self._lib.dtp_recio_next_batch(
+            self._handle, C.byref(block), C.byref(payload), C.byref(starts),
+            C.byref(ends))
+        if nrec < 0:
+            raise DMLCError(
+                f"recordio: {self._lib.dtp_last_error().decode()}")
+        if nrec == 0:
+            return None
+        self._lease = _RecioLease(self, block.value)
+        n = int(nrec)
+        s = np.ctypeslib.as_array(starts, shape=(n,))
+        e = np.ctypeslib.as_array(ends, shape=(n,))
+        data = np.ctypeslib.as_array(payload, shape=(int(e[-1]),))
+        return data, s, e
+
+    def detach(self) -> Optional[_RecioLease]:
+        lease, self._lease = self._lease, None
+        return lease
+
+    def records(self):
+        """Iterate records as bytes (convenience; copies)."""
+        self.before_first()
+        while True:
+            batch = self.next_batch()
+            if batch is None:
+                return
+            data, starts, ends = batch
+            buf = data.tobytes()
+            for i in range(len(starts)):
+                yield buf[int(starts[i]):int(ends[i])]
+
+    def before_first(self) -> None:
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
+        self._lib.dtp_recio_before_first(self._handle)
+
+    def bytes_read(self) -> int:
+        return int(self._lib.dtp_recio_bytes_read(self._handle))
+
+    def get_total_size(self) -> int:
+        return int(self._lib.dtp_recio_total_size(self._handle))
+
+    def stats(self) -> Dict[str, int]:
+        out = (C.c_int64 * 6)()
+        self._lib.dtp_recio_stats(self._handle, out)
+        return {"reader_busy_ns": int(out[0]), "decode_busy_ns": int(out[1]),
+                "wall_ns": int(out[2]), "chunks": int(out[3])}
+
+    def destroy(self) -> None:
+        if getattr(self, "_handle", None):
+            if self._lease is not None:
+                self._lease.release()
+                self._lease = None
+            self._lib.dtp_recio_destroy(self._handle)
             self._handle = None
 
     def __del__(self):

@@ -426,32 +426,45 @@ struct CSRArena {
 };
 
 // ------------------------------------------------------------- file shard
-// Same contract as dmlc_tpu.io.input_split._AlignedSplitBase (text):
-// global concatenation, nstep = ceil(total/nparts), boundary(x) scans
-// through the next newline run, clipped at the containing file's end.
+// Same contract as dmlc_tpu.io.input_split._AlignedSplitBase: global
+// concatenation, nstep = ceil(total/nparts), raw endpoints aligned down
+// to align_bytes, then boundary(x) realigns forward to the next record
+// start (format hook), clipped at the containing file's end. Both a
+// part's begin and its predecessor's end use the same rule, so every
+// record lands in exactly one part.
 
 struct FileEntry {
   std::string path;
   int64_t size;
 };
 
-class TextShardReader {
+class ShardReaderBase {
  public:
-  TextShardReader(std::vector<FileEntry> files, int64_t part, int64_t nparts,
-                  int64_t chunk_bytes)
-      : files_(std::move(files)), chunk_bytes_(std::max<int64_t>(
-            chunk_bytes, 64 * 1024)) {
+  ShardReaderBase(std::vector<FileEntry> files, int64_t chunk_bytes,
+                  int64_t align)
+      : files_(std::move(files)),
+        chunk_bytes_(std::max<int64_t>(chunk_bytes, 64 * 1024)),
+        align_(align) {
     prefix_.push_back(0);
     for (auto& f : files_) prefix_.push_back(prefix_.back() + f.size);
     total_ = prefix_.back();
+  }
+  virtual ~ShardReaderBase() { CloseFile(); }
+
+  // subclasses call this after their vtable is complete (boundary()
+  // invokes the format hooks)
+  void InitPartition(int64_t part, int64_t nparts) {
     int64_t nstep = (total_ + nparts - 1) / nparts;
     int64_t raw_b = std::min(nstep * part, total_);
     int64_t raw_e = std::min(nstep * (part + 1), total_);
+    if (align_ > 1) {
+      raw_b -= raw_b % align_;
+      raw_e -= raw_e % align_;
+    }
     begin_ = boundary(raw_b);
     end_ = boundary(raw_e);
     Reset();
   }
-  ~TextShardReader() { CloseFile(); }
 
   void Reset() {
     CloseFile();
@@ -499,18 +512,27 @@ class TextShardReader {
         if (!out->empty()) return true;
         continue;
       }
-      // cut at last newline; carry the partial tail
-      size_t cut = out->find_last_of("\n\r");
-      if (cut == std::string::npos) {
+      // cut after the last complete record; carry the partial tail
+      size_t cut = FindLastRecordEnd(*out);
+      if (cut == 0) {
         std::swap(leftover_, *out);
         out->clear();
         continue;
       }
-      leftover_.assign(*out, cut + 1, std::string::npos);
-      out->resize(cut + 1);
+      leftover_.assign(*out, cut, std::string::npos);
+      out->resize(cut);
       return true;
     }
   }
+
+ protected:
+  // -- format hooks (reference: LineSplitter/RecordIOSplitter)
+  // bytes to skip from f's position to the next record start; f is the
+  // single containing file (fread stops at its EOF) and boundary()
+  // clamps the result to the file's end, so no explicit limit is needed
+  virtual int64_t SeekRecordBegin(FILE* f) = 0;
+  // length of the longest whole-record prefix of buf (0 = none complete)
+  virtual size_t FindLastRecordEnd(const std::string& buf) = 0;
 
  private:
   void CloseFile() {
@@ -545,24 +567,12 @@ class TextShardReader {
     FILE* f = fopen(files_[i].path.c_str(), "rb");
     if (!f) throw EngineError{"cannot open " + files_[i].path};
     fseeko(f, x - prefix_[i], SEEK_SET);
-    int64_t skipped = 0;
-    bool found_nl = false;
-    char buf[65536];
-    bool done = false;
-    while (!done) {
-      size_t got = fread(buf, 1, sizeof(buf), f);
-      if (got == 0) break;
-      for (size_t k = 0; k < got; ++k) {
-        if (!found_nl) {
-          ++skipped;
-          if (is_nl(buf[k])) found_nl = true;
-        } else if (is_nl(buf[k])) {
-          ++skipped;
-        } else {
-          done = true;
-          break;
-        }
-      }
+    int64_t skipped;
+    try {
+      skipped = SeekRecordBegin(f);
+    } catch (...) {
+      fclose(f);
+      throw;
     }
     fclose(f);
     return std::min(x + skipped, prefix_[i + 1]);
@@ -571,10 +581,199 @@ class TextShardReader {
   std::vector<FileEntry> files_;
   std::vector<int64_t> prefix_;
   int64_t total_ = 0, begin_ = 0, end_ = 0, cur_ = 0;
-  int64_t chunk_bytes_, file_end_ = 0, bytes_read_ = 0;
+  int64_t chunk_bytes_, align_ = 1, file_end_ = 0, bytes_read_ = 0;
   FILE* fp_ = nullptr;
   std::string leftover_;
 };
+
+class TextShardReader : public ShardReaderBase {
+ public:
+  TextShardReader(std::vector<FileEntry> files, int64_t part, int64_t nparts,
+                  int64_t chunk_bytes)
+      : ShardReaderBase(std::move(files), chunk_bytes, /*align=*/1) {
+    InitPartition(part, nparts);
+  }
+
+ protected:
+  // skip through the next newline run (reference: LineSplitter)
+  int64_t SeekRecordBegin(FILE* f) override {
+    int64_t skipped = 0;
+    bool found_nl = false;
+    char buf[65536];
+    while (true) {
+      size_t got = fread(buf, 1, sizeof(buf), f);
+      if (got == 0) return skipped;
+      for (size_t k = 0; k < got; ++k) {
+        if (!found_nl) {
+          ++skipped;
+          if (is_nl(buf[k])) found_nl = true;
+        } else if (is_nl(buf[k])) {
+          ++skipped;
+        } else {
+          return skipped;
+        }
+      }
+    }
+  }
+
+  size_t FindLastRecordEnd(const std::string& buf) override {
+    size_t cut = buf.find_last_of("\n\r");
+    return cut == std::string::npos ? 0 : cut + 1;
+  }
+};
+
+// ----------------------------------------------------------- recordio
+// Frozen format (dmlc_tpu/io/recordio.py; reference include/dmlc/recordio.h
+// + src/recordio.cc): frame = magic(u32 LE) | lrec(u32 LE) | payload |
+// pad-to-4, lrec = cflag<<29 | len, cflag 0 whole / 1 start / 2 middle /
+// 3 end; aligned magic occurrences inside payloads are escaped by frame
+// splitting, so an aligned magic in the stream is always a frame head.
+
+const uint32_t kRecIOMagic = 0xced7230a;
+
+inline uint32_t load_u32le(const char* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;  // little-endian target (static_assert above)
+}
+
+class RecordIOShardReader : public ShardReaderBase {
+ public:
+  RecordIOShardReader(std::vector<FileEntry> files, int64_t part,
+                      int64_t nparts, int64_t chunk_bytes)
+      : ShardReaderBase(std::move(files), chunk_bytes, /*align=*/4) {
+    InitPartition(part, nparts);
+  }
+
+ protected:
+  // scan 4-aligned words for a frame head that STARTS a record
+  // (cflag 0 or 1 — continuation frames are not record starts);
+  // reference: src/io/recordio_split.cc SeekRecordBegin
+  int64_t SeekRecordBegin(FILE* f) override {
+    int64_t nstep = 0;
+    std::string window;
+    char buf[65536];
+    while (true) {
+      size_t got = fread(buf, 1, sizeof(buf), f);
+      if (got == 0) return nstep + (int64_t)window.size();
+      window.append(buf, got);
+      size_t pos = 0;
+      while (pos + 8 <= window.size()) {
+        if (load_u32le(window.data() + pos) == kRecIOMagic) {
+          uint32_t lrec = load_u32le(window.data() + pos + 4);
+          uint32_t cflag = (lrec >> 29) & 7;
+          if (cflag == 0 || cflag == 1) return nstep + (int64_t)pos;
+        }
+        pos += 4;
+      }
+      nstep += (int64_t)pos;
+      window.erase(0, pos);
+    }
+  }
+
+  // walk whole frames; a record completes at a cflag 0 or 3 frame
+  size_t FindLastRecordEnd(const std::string& buf) override {
+    size_t pos = 0, complete_end = 0, n = buf.size();
+    bool in_multi = false;
+    while (pos + 8 <= n) {
+      if (load_u32le(buf.data() + pos) != kRecIOMagic)
+        throw EngineError{"recordio: lost frame alignment in shard read"};
+      uint32_t lrec = load_u32le(buf.data() + pos + 4);
+      uint32_t cflag = (lrec >> 29) & 7;
+      size_t clen = lrec & ((1u << 29) - 1);
+      size_t frame_end = pos + 8 + clen + ((4 - (clen & 3)) & 3);
+      if (frame_end > n) break;
+      if (cflag == 0) {
+        complete_end = frame_end;
+        in_multi = false;
+      } else if (cflag == 1) {
+        in_multi = true;
+      } else if (cflag == 3) {
+        if (!in_multi)
+          throw EngineError{"recordio: end-frame without start"};
+        complete_end = frame_end;
+        in_multi = false;
+      }
+      pos = frame_end;
+    }
+    return complete_end;
+  }
+};
+
+// A decoded batch of records: record i = data[starts[i], ends[i]).
+// The chunk buffer itself is the payload store — single-frame records
+// (the overwhelmingly common case) are pure views at their original
+// position; multi-frame records are stitched IN PLACE (the stitched
+// length is always shorter than the framed extent: each extra frame
+// drops an 8-byte header and re-inserts 4 magic bytes), so decode
+// touches only frame headers + the rare multi-frame payloads. Zero-copy
+// at the ABI with the same lease semantics as parser blocks.
+struct RecBatch {
+  std::string data;           // the chunk, multi-frame records compacted
+  Buf<int64_t> starts, ends;  // per-record [start, end) into data
+
+  void clear() {
+    data.clear();
+    starts.clear();
+    ends.clear();
+  }
+};
+
+// decode a chunk of whole frames, stitching multi-frame records in
+// place (reference: RecordIOChunkReader::NextRecord — escaped magics
+// re-inserted between the frames of a multi-frame record)
+void DecodeRecordIOChunkInPlace(RecBatch* out) {
+  char* d = out->data.data();
+  size_t n = out->data.size(), pos = 0;
+  out->starts.reserve(n / 64 + 1);
+  out->ends.reserve(n / 64 + 1);
+  bool in_multi = false;
+  int64_t rec_start = 0, cursor = 0;  // stitch state (multi-frame only)
+  while (pos < n) {
+    if (pos + 8 > n)
+      throw EngineError{"recordio: truncated frame header"};
+    if (load_u32le(d + pos) != kRecIOMagic)
+      throw EngineError{"recordio: invalid magic"};
+    uint32_t lrec = load_u32le(d + pos + 4);
+    uint32_t cflag = (lrec >> 29) & 7;
+    size_t clen = lrec & ((1u << 29) - 1);
+    size_t start = pos + 8;
+    if (start + clen > n)
+      throw EngineError{"recordio: truncated payload"};
+    // cflag semantics (golden: recordio.py decode path): 0 whole,
+    // 1 start, 2 middle, >=3 end — a continuation (>=2) without a
+    // start frame is an error, matching the Python decoder
+    if (in_multi && (cflag == 0 || cflag == 1))
+      throw EngineError{"recordio: new record inside multi-frame record"};
+    if (!in_multi && cflag >= 2)
+      throw EngineError{"recordio: continuation frame without start"};
+    switch (cflag) {
+      case 0:  // whole record: a pure view, nothing moves
+        out->starts.push_back((int64_t)start);
+        out->ends.push_back((int64_t)(start + clen));
+        break;
+      case 1:  // start frame: payload already in place
+        rec_start = (int64_t)start;
+        cursor = (int64_t)(start + clen);
+        in_multi = true;
+        break;
+      default:  // 2 middle / >=3 end: re-insert magic, compact down
+        std::memcpy(d + cursor, &kRecIOMagic, 4);
+        cursor += 4;
+        std::memmove(d + cursor, d + start, clen);
+        cursor += (int64_t)clen;
+        if (cflag >= 3) {
+          out->starts.push_back(rec_start);
+          out->ends.push_back(cursor);
+          in_multi = false;
+        }
+        break;
+    }
+    pos = start + clen + ((4 - (clen & 3)) & 3);
+  }
+  if (in_multi)
+    throw EngineError{"recordio: truncated multi-frame record"};
+}
 
 // ----------------------------------------------------------- format parse
 
@@ -1252,6 +1451,127 @@ struct ParserHandle {
   }
 };
 
+// reader thread -> bounded chunk queue -> consumer-side decode
+// (decode is memcpy-bound; the reader overlap is the win)
+struct RecordIOHandle {
+  std::unique_ptr<RecordIOShardReader> reader;
+  std::unique_ptr<std::thread> reader_thread;
+  std::unique_ptr<BoundedQueue<ChunkItem>> chunks;
+  std::string reader_error;      // set before chunks->Finish()
+  std::atomic<bool> reader_failed{false};
+  std::string error;
+  PipelineStats stats;
+
+  std::mutex pool_mu;
+  std::vector<std::unique_ptr<RecBatch>> batch_pool;
+  std::vector<std::string> chunk_pool;
+  std::map<RecBatch*, std::unique_ptr<RecBatch>> outstanding;
+  RecBatch* last = nullptr;
+
+  ~RecordIOHandle() { StopPipeline(); }
+
+  void StopPipeline() {
+    if (chunks) chunks->Kill();
+    if (reader_thread && reader_thread->joinable()) reader_thread->join();
+    reader_thread.reset();
+    chunks.reset();
+  }
+
+  void StartPipeline() {
+    StopPipeline();
+    reader->Reset();
+    stats.Reset();
+    reader_failed = false;
+    chunks = std::make_unique<BoundedQueue<ChunkItem>>(4);
+    reader_thread = std::make_unique<std::thread>([this] {
+      try {
+        while (true) {
+          ChunkItem item;
+          {
+            std::lock_guard<std::mutex> lk(pool_mu);
+            if (!chunk_pool.empty()) {
+              item.data = std::move(chunk_pool.back());
+              chunk_pool.pop_back();
+            }
+          }
+          int64_t t0 = now_ns();
+          bool more = reader->NextChunk(&item.data);
+          stats.reader_busy_ns += now_ns() - t0;
+          if (!more) break;
+          stats.chunks += 1;
+          if (!chunks->Push(std::move(item))) return;
+        }
+      } catch (const EngineError& err) {
+        reader_error = err.msg;
+        reader_failed = true;
+      } catch (const std::exception& ex) {
+        reader_error = ex.what();
+        reader_failed = true;
+      }
+      chunks->Finish();
+    });
+  }
+
+  // records in batch; 0 = end; -1 = error (message in this->error)
+  int64_t NextBatch() {
+    if (!chunks) StartPipeline();
+    ChunkItem item;
+    while (chunks->Pop(&item)) {
+      std::unique_ptr<RecBatch> batch;
+      {
+        std::lock_guard<std::mutex> lk(pool_mu);
+        if (!batch_pool.empty()) {
+          batch = std::move(batch_pool.back());
+          batch_pool.pop_back();
+          batch->clear();
+        }
+      }
+      if (!batch) batch = std::make_unique<RecBatch>();
+      batch->data = std::move(item.data);  // chunk IS the payload store
+      int64_t t0 = now_ns();
+      try {
+        DecodeRecordIOChunkInPlace(batch.get());
+      } catch (const EngineError& err) {
+        error = err.msg;
+        stats.end_ns = now_ns();
+        return -1;
+      }
+      stats.parse_busy_ns += now_ns() - t0;
+      if (batch->starts.empty()) {  // no complete records
+        std::lock_guard<std::mutex> lk(pool_mu);
+        batch_pool.push_back(std::move(batch));
+        continue;
+      }
+      RecBatch* raw = batch.get();
+      {
+        std::lock_guard<std::mutex> lk(pool_mu);
+        outstanding[raw] = std::move(batch);
+      }
+      last = raw;
+      return (int64_t)raw->starts.size();
+    }
+    stats.end_ns = now_ns();
+    if (reader_failed) {
+      error = reader_error;
+      return -1;
+    }
+    return 0;
+  }
+
+  void Release(RecBatch* b) {
+    std::lock_guard<std::mutex> lk(pool_mu);
+    auto it = outstanding.find(b);
+    if (it == outstanding.end()) return;
+    // hand the chunk buffer's capacity back to the reader
+    if (chunk_pool.size() < 6)
+      chunk_pool.push_back(std::move(it->second->data));
+    it->second->clear();
+    batch_pool.push_back(std::move(it->second));
+    outstanding.erase(it);
+  }
+};
+
+
 Format parse_format(const char* fmt) {
   std::string f(fmt);
   if (f == "libsvm") return Format::kLibSVM;
@@ -1396,6 +1716,83 @@ int64_t dtp_parser_total_size(void* handle) {
 
 void dtp_parser_destroy(void* handle) {
   delete static_cast<ParserHandle*>(handle);
+}
+
+// ------------------------------------------------- recordio reader ABI
+
+void* dtp_recio_create(const char** paths, const int64_t* sizes,
+                       int64_t nfiles, int64_t part, int64_t nparts,
+                       int64_t chunk_bytes) {
+  try {
+    auto h = std::make_unique<RecordIOHandle>();
+    std::vector<FileEntry> files;
+    for (int64_t i = 0; i < nfiles; ++i)
+      files.push_back({paths[i], sizes[i]});
+    h->reader = std::make_unique<RecordIOShardReader>(
+        std::move(files), part, nparts, chunk_bytes);
+    return h.release();
+  } catch (const EngineError& e) {
+    g_last_error = e.msg;
+    return nullptr;
+  }
+}
+
+// Pull the next batch. Returns nrec (>0), 0 at end, -1 on error.
+// Record i = payload[starts[i], ends[i]) — views into the leased chunk
+// (multi-frame records stitched in place); valid until
+// dtp_recio_block_release(handle, *block_out) or destroy.
+int64_t dtp_recio_next_batch(void* handle, void** block_out,
+                             const uint8_t** payload,
+                             const int64_t** starts,
+                             const int64_t** ends) {
+  auto* h = static_cast<RecordIOHandle*>(handle);
+  int64_t nrec = h->NextBatch();
+  if (nrec < 0) {
+    g_last_error = h->error;
+    return -1;
+  }
+  if (nrec == 0) return 0;
+  RecBatch* b = h->last;
+  *block_out = b;
+  *payload = reinterpret_cast<const uint8_t*>(b->data.data());
+  *starts = b->starts.data();
+  *ends = b->ends.data();
+  return nrec;
+}
+
+void dtp_recio_block_release(void* handle, void* block) {
+  if (!handle || !block) return;
+  static_cast<RecordIOHandle*>(handle)->Release(
+      static_cast<RecBatch*>(block));
+}
+
+void dtp_recio_before_first(void* handle) {
+  auto* h = static_cast<RecordIOHandle*>(handle);
+  h->StopPipeline();
+  h->last = nullptr;
+}
+
+int64_t dtp_recio_bytes_read(void* handle) {
+  return static_cast<RecordIOHandle*>(handle)->reader->bytes_read();
+}
+
+int64_t dtp_recio_total_size(void* handle) {
+  return static_cast<RecordIOHandle*>(handle)->reader->total_size();
+}
+
+void dtp_recio_stats(void* handle, int64_t* out) {
+  auto* h = static_cast<RecordIOHandle*>(handle);
+  out[0] = h->stats.reader_busy_ns.load();
+  out[1] = h->stats.parse_busy_ns.load();
+  int64_t end = h->stats.end_ns.load();
+  out[2] = (end ? end : now_ns()) - h->stats.start_ns;
+  out[3] = h->stats.chunks.load();
+  out[4] = 0;
+  out[5] = 0;
+}
+
+void dtp_recio_destroy(void* handle) {
+  delete static_cast<RecordIOHandle*>(handle);
 }
 
 // strtonum parity probes (tests compare against the Python golden)

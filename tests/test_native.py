@@ -419,6 +419,98 @@ class TestZeroCopyLease:
         assert c.get_block().content_hash() == first_pass
 
 
+class TestNativeRecordIO:
+    """Native sharded RecordIO reader: record-stream parity with the
+    Python split (reference: src/io/recordio_split.cc + src/recordio.cc),
+    including multi-frame (escaped magic) records and multi-part shards."""
+
+    @pytest.fixture
+    def rec_files(self, tmp_path, rng):
+        from dmlc_tpu.io.recordio import RecordIOWriter, RECORDIO_MAGIC
+        import struct
+        magic = struct.pack("<I", RECORDIO_MAGIC)
+        paths = []
+        for f in range(3):
+            p = tmp_path / f"part{f}.rec"
+            with open(p, "wb") as fh:
+                w = RecordIOWriter(fh)
+                for i in range(120):
+                    if i % 7 == 0:
+                        # adversarial: aligned magic inside the payload
+                        # forces multi-frame escaping
+                        rec = (b"A" * (4 * rng.randint(0, 8)) + magic +
+                               rng.bytes(rng.randint(0, 64)))
+                    else:
+                        rec = rng.bytes(rng.randint(1, 3000))
+                    w.write_record(rec)
+            paths.append(str(p))
+        return ";".join(paths)
+
+    def _python_records(self, uri, k, n):
+        from dmlc_tpu.io.input_split import InputSplit
+        return list(InputSplit.create(uri, k, n, "recordio"))
+
+    def _native_records(self, uri, k, n, chunk=1 << 20):
+        from dmlc_tpu.native.bindings import NativeRecordIOReader
+        r = NativeRecordIOReader(uri, k, n, chunk_size=chunk)
+        out = list(r.records())
+        r.destroy()
+        return out
+
+    @pytest.mark.parametrize("nparts", [1, 2, 5])
+    def test_record_parity(self, rec_files, nparts):
+        for k in range(nparts):
+            g = self._python_records(rec_files, k, nparts)
+            n = self._native_records(rec_files, k, nparts)
+            assert len(g) == len(n)
+            assert g == n, f"part {k}/{nparts} diverges"
+
+    def test_small_chunks_force_carry(self, rec_files):
+        # 64KB chunks (engine minimum) make records straddle chunk cuts
+        g = self._python_records(rec_files, 0, 1)
+        n = self._native_records(rec_files, 0, 1, chunk=1)
+        assert g == n
+
+    def test_zero_copy_batches(self, rec_files):
+        from dmlc_tpu.native.bindings import NativeRecordIOReader
+        r = NativeRecordIOReader(rec_files, 0, 1)
+        total = 0
+        while True:
+            batch = r.next_batch()
+            if batch is None:
+                break
+            data, starts, ends = batch
+            assert np.all(starts <= ends) and int(ends[-1]) == len(data)
+            assert np.all(ends[:-1] <= starts[1:])  # in-order, no overlap
+            total += len(starts)
+        stats = r.stats()
+        assert stats["chunks"] >= 1 and stats["reader_busy_ns"] > 0
+        r.destroy()
+        assert total == len(self._python_records(rec_files, 0, 1))
+
+    def test_corrupt_stream_raises(self, tmp_path):
+        from dmlc_tpu.native.bindings import NativeRecordIOReader
+        p = tmp_path / "bad.rec"
+        p.write_bytes(b"\x00" * 64)  # no magic anywhere
+        # offset 0 is a record start by contract (no realignment scan), so
+        # garbage at 0 errors in BOTH engines (python parity checked above)
+        with pytest.raises(DMLCError, match="magic"):
+            self._python_records(str(p), 0, 1)
+        r = NativeRecordIOReader(str(p), 0, 1)
+        with pytest.raises(DMLCError, match="magic"):
+            r.next_batch()
+        r.destroy()
+        from dmlc_tpu.io.recordio import RECORDIO_MAGIC
+        import struct
+        # valid magic + truncated payload must error, not hang
+        p2 = tmp_path / "trunc.rec"
+        p2.write_bytes(struct.pack("<II", RECORDIO_MAGIC, 5000))
+        r2 = NativeRecordIOReader(str(p2), 0, 1)
+        with pytest.raises(DMLCError):
+            r2.next_batch()
+        r2.destroy()
+
+
 class TestCppUnittests:
     """Build and run the native C++ unit-test program (reference:
     test/unittest gtest suite; see engine_unittest.cc)."""
