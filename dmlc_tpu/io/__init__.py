@@ -14,10 +14,14 @@ from dmlc_tpu.io.input_split import InputSplit
 from dmlc_tpu.io.recordio import (
     RecordIOWriter, RecordIOReader, RecordIOChunkReader, RECORDIO_MAGIC,
 )
+from dmlc_tpu.io.tpu_fs import (  # registers the tpu:// scheme on import
+    TPUFileSystem, TPUSeekStream, recordio_device_batches,
+)
 
 __all__ = [
     "Stream", "SeekStream", "MemoryStream", "Serializable", "create_stream",
     "create_seek_stream_for_read", "FileSystem", "FileInfo", "URI",
     "LocalFileSystem", "TemporaryDirectory", "InputSplit",
     "RecordIOWriter", "RecordIOReader", "RecordIOChunkReader", "RECORDIO_MAGIC",
+    "TPUFileSystem", "TPUSeekStream", "recordio_device_batches",
 ]

@@ -1,0 +1,254 @@
+"""tpu:// URI scheme: streams that stage bytes straight into device HBM.
+
+The north-star contract (BASELINE.json): "Stream/SeekStream gain a
+tpu:// URI that DMAs RecordIO chunks straight to device". There is no
+portable file->HBM DMA primitive in JAX, so the honest TPU-native
+mechanism is: host staging read + ASYNC ``jax.device_put`` (which on TPU
+runtimes is a DMA from host staging memory over PCIe/ICI), with a
+lookahead window so transfer N+1 is in flight while the consumer uses
+chunk N. That is exactly the reference's ThreadedInputSplit double-buffer
+re-aimed at the host->HBM edge.
+
+URI shape: ``tpu:///abs/path`` (or ``tpu://rel/path``) — the path after
+the scheme is served by the local VFS. Reads/seeks behave as a normal
+SeekStream (host bytes); the device-side API is additive:
+
+- ``TPUSeekStream.read_to_device(n)`` -> device-resident uint8 jax.Array
+- ``TPUSeekStream.device_chunks(chunk_bytes, lookahead)`` -> iterator of
+  device chunks with ``lookahead`` transfers in flight
+- ``recordio_device_batches(uri, part, nparts)`` -> sharded RecordIO
+  record batches as device arrays (payload u8 + starts/ends i64), the
+  "RecordIO chunks straight to device" path, zero host-side record copy
+  when the native engine is built.
+
+Writes accept bytes or (jax/numpy) arrays — a device array is pulled to
+host once and streamed out, which is the checkpoint-write direction.
+
+Reference seam: src/io/filesys.cc scheme registry + the io.h Stream
+contract; no reference counterpart exists for the device staging (CUDA
+GPUDirect would be the CUDA-world analogue; XLA exposes no equivalent,
+so device_put IS the TPU-native transport).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from dmlc_tpu.io.filesys import FileInfo, FileSystem, URI
+from dmlc_tpu.io.stream import SeekStream, Stream
+from dmlc_tpu.utils.logging import check
+
+__all__ = ["TPUFileSystem", "TPUSeekStream", "TPUWriteStream",
+           "recordio_device_batches"]
+
+_SCHEME = "tpu://"
+
+
+def _inner_path(uri: URI) -> str:
+    """tpu:///abs/x -> /abs/x ; tpu://rel/x -> rel/x."""
+    return uri.host + uri.name
+
+
+class TPUSeekStream(SeekStream):
+    """SeekStream over host bytes + device-chunk staging API."""
+
+    def __init__(self, inner: SeekStream, path: str):
+        self._inner = inner
+        self.path = path
+
+    # -- plain SeekStream (host bytes)
+
+    def read(self, nbytes: int) -> bytes:
+        return self._inner.read(nbytes)
+
+    def write(self, data) -> int:  # pragma: no cover - read stream
+        return self._inner.write(data)
+
+    def seek(self, pos: int) -> None:
+        self._inner.seek(pos)
+
+    def tell(self) -> int:
+        return self._inner.tell()
+
+    def close(self) -> None:
+        self._inner.close()
+
+    # -- device staging
+
+    def read_to_device(self, nbytes: int, device=None):
+        """Read up to nbytes from the current position into device HBM.
+
+        Returns a uint8 jax.Array (async transfer — not blocked on), or
+        None at EOF. The transfer is enqueued immediately; callers that
+        need completion use jax.block_until_ready.
+        """
+        import jax
+        import numpy as np
+        raw = self._inner.read(nbytes)
+        if not raw:
+            return None
+        host = np.frombuffer(raw, dtype=np.uint8)
+        return (jax.device_put(host, device) if device is not None
+                else jax.device_put(host))
+
+    def device_chunks(self, chunk_bytes: int = 8 << 20, lookahead: int = 2,
+                      device=None) -> Iterator:
+        """Iterate the stream as device-resident uint8 chunks with
+        ``lookahead`` transfers in flight (read/transfer overlap)."""
+        check(lookahead >= 1, "lookahead must be >= 1")
+        pending: List = []
+        while True:
+            while len(pending) < lookahead:
+                chunk = self.read_to_device(chunk_bytes, device)
+                if chunk is None:
+                    break
+                pending.append(chunk)
+            if not pending:
+                return
+            yield pending.pop(0)
+
+
+class TPUWriteStream(Stream):
+    """Write stream accepting bytes or arrays (device arrays are pulled
+    to host once — the checkpoint-write direction)."""
+
+    def __init__(self, inner: Stream, path: str):
+        self._inner = inner
+        self.path = path
+
+    def write(self, data) -> int:
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            import numpy as np
+            data = np.asarray(data).tobytes()  # device -> host, once
+        return self._inner.write(data)
+
+    def read(self, nbytes: int) -> bytes:  # pragma: no cover - write stream
+        return self._inner.read(nbytes)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class TPUFileSystem(FileSystem):
+    """tpu:// scheme: local VFS metadata + device-staging streams."""
+
+    def _local(self) -> FileSystem:
+        fs = FileSystem.get_instance(URI("/"))
+        assert fs is not None
+        return fs
+
+    def open(self, uri: URI, mode: str) -> Stream:
+        path = _inner_path(uri)
+        inner = self._local().open(URI(path), mode)
+        if mode == "r":
+            return TPUSeekStream(inner, path)
+        return TPUWriteStream(inner, path)
+
+    def open_for_read(self, uri: URI) -> TPUSeekStream:
+        path = _inner_path(uri)
+        return TPUSeekStream(self._local().open_for_read(URI(path)), path)
+
+    def get_path_info(self, uri: URI) -> FileInfo:
+        info = self._local().get_path_info(URI(_inner_path(uri)))
+        return FileInfo(path=_SCHEME + info.path, size=info.size,
+                        type=info.type)
+
+    def list_directory(self, uri: URI) -> List[FileInfo]:
+        return [FileInfo(path=_SCHEME + fi.path, size=fi.size, type=fi.type)
+                for fi in self._local().list_directory(URI(_inner_path(uri)))]
+
+
+def recordio_device_batches(uri: str, part_index: int = 0,
+                            num_parts: int = 1, *,
+                            chunk_size: int = 8 << 20, lookahead: int = 2,
+                            device=None) -> Iterator[dict]:
+    """Sharded RecordIO ingest straight to device HBM.
+
+    Yields dicts {"payload": u8 jax.Array, "starts": i64, "ends": i64}
+    (record i = payload[starts[i]:ends[i]]). With the native engine the
+    host path is zero-copy (engine chunk buffer -> device_put) and
+    ``lookahead`` batches' transfers overlap the next chunk's read+decode;
+    falls back to the Python split otherwise. Accepts plain or tpu://
+    URIs (the scheme prefix is stripped for the byte source).
+    """
+    import jax
+    import numpy as np
+    if uri.startswith(_SCHEME):
+        u = URI(uri)
+        uri = _inner_path(u)
+    check(lookahead >= 1, "lookahead must be >= 1")
+
+    plat = device.platform if device is not None else jax.default_backend()
+
+    def _put(arrs):
+        out = {}
+        for k, v in arrs.items():
+            if plat == "cpu":
+                # CPU jax.device_put may ALIAS the host buffer instead of
+                # copying; our source is a leased native arena that gets
+                # recycled on release, so an owned copy is mandatory here.
+                # On TPU the device_put is a real host->HBM transfer.
+                v = np.array(v, copy=True)
+            out[k] = (jax.device_put(v, device) if device is not None
+                      else jax.device_put(v))
+        return out
+
+    from dmlc_tpu.native import native_available
+    pending: List = []  # (device batch, lease or None)
+    if native_available():
+        from dmlc_tpu.native.bindings import NativeRecordIOReader
+        reader = NativeRecordIOReader(uri, part_index, num_parts,
+                                      chunk_size=chunk_size)
+        try:
+            while True:
+                batch = reader.next_batch()
+                if batch is None:
+                    break
+                data, starts, ends = batch
+                dev = _put({"payload": data, "starts": starts, "ends": ends})
+                pending.append((dev, reader.detach()))
+                if len(pending) > lookahead:
+                    out, lease = pending.pop(0)
+                    jax.block_until_ready(out)
+                    if lease is not None:
+                        lease.release()
+                    yield out
+            while pending:
+                out, lease = pending.pop(0)
+                jax.block_until_ready(out)
+                if lease is not None:
+                    lease.release()
+                yield out
+        finally:
+            # early close/exception: in-flight transfers still read the
+            # leased native buffers — drain before destroy frees them
+            for out, lease in pending:
+                jax.block_until_ready(out)
+                if lease is not None:
+                    lease.release()
+            reader.destroy()
+        return
+    # python fallback: one batch per split chunk
+    from dmlc_tpu.io.input_split import InputSplit
+    split = InputSplit.create(uri, part_index, num_parts, "recordio",
+                              chunk_size=chunk_size)
+    while True:
+        chunk = split.next_chunk()
+        if chunk is None:
+            break
+        records = list(split.extract_records(chunk))
+        if not records:
+            continue
+        payload = np.frombuffer(b"".join(records), dtype=np.uint8)
+        ends = np.cumsum([len(r) for r in records], dtype=np.int64)
+        starts = np.concatenate([[0], ends[:-1]]).astype(np.int64)
+        dev = _put({"payload": payload, "starts": starts, "ends": ends})
+        pending.append((dev, None))
+        if len(pending) > lookahead:
+            out, _ = pending.pop(0)
+            yield out
+    for out, _ in pending:
+        yield out
+
+
+FileSystem.register_scheme(_SCHEME, TPUFileSystem)
