@@ -180,14 +180,15 @@ def recordio_device_batches(uri: str, part_index: int = 0,
 
     plat = device.platform if device is not None else jax.default_backend()
 
-    def _put(arrs):
+    def _put(arrs, leased: bool):
         out = {}
         for k, v in arrs.items():
-            if plat == "cpu":
+            if leased and plat == "cpu":
                 # CPU jax.device_put may ALIAS the host buffer instead of
-                # copying; our source is a leased native arena that gets
-                # recycled on release, so an owned copy is mandatory here.
-                # On TPU the device_put is a real host->HBM transfer.
+                # copying; a leased native arena gets recycled on release,
+                # so an owned copy is mandatory for leased sources there.
+                # (TPU device_put is a real host->HBM transfer; the python
+                # fallback's buffers are already owned.)
                 v = np.array(v, copy=True)
             out[k] = (jax.device_put(v, device) if device is not None
                       else jax.device_put(v))
@@ -205,7 +206,8 @@ def recordio_device_batches(uri: str, part_index: int = 0,
                 if batch is None:
                     break
                 data, starts, ends = batch
-                dev = _put({"payload": data, "starts": starts, "ends": ends})
+                dev = _put({"payload": data, "starts": starts,
+                            "ends": ends}, leased=True)
                 pending.append((dev, reader.detach()))
                 if len(pending) > lookahead:
                     out, lease = pending.pop(0)
@@ -242,7 +244,8 @@ def recordio_device_batches(uri: str, part_index: int = 0,
         payload = np.frombuffer(b"".join(records), dtype=np.uint8)
         ends = np.cumsum([len(r) for r in records], dtype=np.int64)
         starts = np.concatenate([[0], ends[:-1]]).astype(np.int64)
-        dev = _put({"payload": payload, "starts": starts, "ends": ends})
+        dev = _put({"payload": payload, "starts": starts, "ends": ends},
+                   leased=False)
         pending.append((dev, None))
         if len(pending) > lookahead:
             out, _ = pending.pop(0)

@@ -1,0 +1,96 @@
+"""Distributed worker for tests/test_multiprocess.py.
+
+Runs as a REAL separate OS process under launch_local (reference
+mechanism: tracker/dmlc_tracker/local.py forking workers that actually
+connect to the tracker): calls init_from_env() to join the
+jax.distributed rendezvous, builds a global mesh over all processes'
+devices, streams skew-sharded data through ShardedRowBlockIter, trains a
+SparseLinearModel for two epochs, saves a ShardedCheckpoint, and (in the
+"restore" phase, a fresh launch simulating restart) restores it and
+verifies byte-identical params before taking one more step.
+
+Usage: mp_worker.py <data_uri> <out_dir> <train|restore>
+Writes <out_dir>/result-<phase>-<rank>.json with what the test asserts.
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # this machine's axon TPU plugin overrides the env var; the config
+    # update is authoritative (same dance as tests/conftest.py)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+NUM_FEATURES = 2048
+ROW_BUCKET = 64
+NNZ_BUCKET = 1024
+
+
+def main() -> int:
+    data_uri, out_dir, phase = sys.argv[1], sys.argv[2], sys.argv[3]
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dmlc_tpu.io.checkpoint import ShardedCheckpoint
+    from dmlc_tpu.models.linear import SparseLinearModel
+    from dmlc_tpu.parallel.launch import init_from_env, finalize
+    from dmlc_tpu.parallel.sharded import ShardedRowBlockIter
+
+    pid, nprocs = init_from_env()
+    assert jax.process_count() == nprocs, (jax.process_count(), nprocs)
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    model = SparseLinearModel(num_features=NUM_FEATURES, learning_rate=0.5)
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(model.init_params(), replicated)
+    step_fn = model.make_sharded_train_step(mesh)
+    it = ShardedRowBlockIter(data_uri, mesh, format="libsvm",
+                             row_bucket=ROW_BUCKET, nnz_bucket=NNZ_BUCKET)
+    ck = ShardedCheckpoint(os.path.join(out_dir, "ckpt"))
+
+    def digest(p):
+        h = hashlib.sha256()
+        h.update(np.asarray(p["w"]).tobytes())
+        h.update(np.asarray(p["b"]).tobytes())
+        return h.hexdigest()
+
+    if phase == "train":
+        nbatches = 0
+        last_loss = None
+        for _epoch in range(2):
+            for batch in it:
+                params, loss = step_fn(params, batch)
+                nbatches += 1
+                last_loss = float(loss)
+        ck.save(nbatches, params, metadata={"nbatches": nbatches})
+        result = {"rank": pid, "world": nprocs, "nbatches": nbatches,
+                  "loss": last_loss, "params_digest": digest(params),
+                  "w_head": np.asarray(params["w"])[:8].tolist()}
+    elif phase == "restore":
+        restored, user = ck.restore(like=params)
+        # exercise the restored params: one more global step must run
+        batch = next(iter(it))
+        stepped, loss = step_fn(restored, batch)
+        result = {"rank": pid, "world": nprocs,
+                  "restored_digest": digest(restored),
+                  "restore_bytes": ck.last_restore_bytes_read,
+                  "meta_nbatches": user["nbatches"],
+                  "post_restore_loss": float(loss),
+                  "stepped_digest": digest(stepped)}
+    else:
+        raise SystemExit(f"unknown phase {phase!r}")
+
+    with open(os.path.join(out_dir, f"result-{phase}-{pid}.json"),
+              "w") as f:
+        json.dump(result, f)
+    finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,7 @@
 """Checkpoint subsystem + JSON utilities (reference: Serializable/Stream
 checkpoint primitives + json.h; TPU-native sharded checkpoint)."""
 
+import json
 import os
 
 import numpy as np
@@ -151,3 +152,120 @@ class TestCheckpointRegressions:
         shard_file = os.path.join(d, "shard-0.bin")
         size = os.path.getsize(shard_file)
         assert size < big.nbytes * 1.5  # one copy + framing, not 8 copies
+
+
+class TestShardLocalRestore:
+    """Restore must read only the placements intersecting the target
+    sharding's addressable slices (VERDICT r1 #5): peak host memory ~
+    local shard bytes, built via make_array_from_single_device_arrays."""
+
+    def _tree(self, n=1 << 12):
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+        sh = NamedSharding(mesh, P("data"))
+        x = jax.device_put(jnp.arange(float(n), dtype=jnp.float32), sh)
+        return {"x": x}, mesh, sh
+
+    def test_restore_reads_only_needed_placements(self, tmp_path):
+        tree, mesh, sh = self._tree()
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        ck.save(1, tree)
+        # single-process: all 8 devices are addressable, so the whole row
+        # space is needed — the probe is that each placement is read
+        # EXACTLY once (no full-file rescans, no per-device re-reads)
+        restored, _ = ck.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+        # every byte read was a needed placement: total == stored bytes
+        # of x exactly once (8 placements, no re-reads, no full-file scan)
+        assert ck.last_restore_bytes_read <= tree["x"].nbytes + 8 * 64
+
+    def test_accounting_scales_with_slice(self, tmp_path):
+        # restore only x (sharded); a second huge leaf must NOT be read
+        tree, mesh, sh = self._tree()
+        big = jax.device_put(jnp.zeros((1 << 15,), jnp.float32),
+                             NamedSharding(mesh, P()))
+        full = {"x": tree["x"], "big": big}
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        ck.save(1, full)
+        restored, _ = ck.restore(like={"x": tree["x"]})
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+        assert ck.last_restore_bytes_read < big.nbytes // 2, \
+            "restore read leaves outside the requested tree"
+
+    def test_reshard_on_restore(self, tmp_path):
+        # stored on 8 devices, restored onto a 4-device mesh (placement-
+        # driven assembly, mesh-topology independent)
+        tree, mesh, sh = self._tree()
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        ck.save(1, tree)
+        mesh4 = Mesh(np.array(jax.devices()[:4]).reshape(4), ("data",))
+        sh4 = NamedSharding(mesh4, P("data"))
+        like = jax.device_put(jnp.zeros_like(np.asarray(tree["x"])), sh4)
+        restored, _ = ck.restore(like={"x": like})
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+        assert restored["x"].sharding.is_equivalent_to(sh4, ndim=1)
+
+    def test_scalar_leaf_does_not_pull_full_model(self, tmp_path):
+        # regression: an unsharded leaf (step counter) in `like` must not
+        # trigger a full-model host assembly of the sharded leaves
+        tree, mesh, sh = self._tree()
+        full = {"x": tree["x"], "step": np.int64(7)}
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        ck.save(1, full)
+        restored, _ = ck.restore(like=full)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+        assert int(restored["step"]) == 7
+        # x read exactly once + the scalar, not twice
+        assert ck.last_restore_bytes_read <= tree["x"].nbytes + 1024
+
+    def test_replicated_saved_to_sharded_target_reads_once(self, tmp_path):
+        # regression: a replicated-SAVED leaf restored onto a sharded
+        # target must read the single stored record once, not once per
+        # device span (was 8x I/O)
+        tree, mesh, _ = self._tree()
+        repl = NamedSharding(mesh, P())
+        w = jax.device_put(jnp.arange(4096.0, dtype=jnp.float32), repl)
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        ck.save(1, {"w": w})
+        sh = NamedSharding(mesh, P("data"))
+        like = jax.device_put(jnp.zeros(4096, jnp.float32), sh)
+        restored, _ = ck.restore(like={"w": like})
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(4096.0))
+        assert ck.last_restore_bytes_read <= w.nbytes + 1024
+
+    def test_missing_index_file_still_restores(self, tmp_path):
+        # regression: mixed indexed/unindexed shard files (version skew,
+        # lost idx) must restore via the structural scan, and a stale
+        # index whose bin_size mismatches is rejected in favor of a scan
+        tree, mesh, sh = self._tree()
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        d = ck.save(1, tree)
+        idx = os.path.join(d, "shard-0.idx.json")
+        os.remove(idx)  # simulate a pre-index writer / lost idx
+        restored, _ = ck.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+        # stale index: wrong bin_size must be ignored, not trusted
+        with open(idx, "w") as f:
+            json.dump({"entries": [], "bin_size": 1}, f)
+        ck2 = ShardedCheckpoint(str(tmp_path / "r"))
+        restored2, _ = ck2.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored2["x"]),
+                                      np.asarray(tree["x"]))
+
+    def test_replicated_target_restores(self, tmp_path):
+        tree, mesh, _ = self._tree()
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        ck.save(1, tree)
+        repl = NamedSharding(mesh, P())
+        like = jax.device_put(jnp.zeros_like(np.asarray(tree["x"])), repl)
+        restored, _ = ck.restore(like={"x": like})
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+        # 8 replicated devices share one assembled slice (cache), so the
+        # stored data is read once, not 8 times
+        assert ck.last_restore_bytes_read <= tree["x"].nbytes + 8 * 64
