@@ -135,17 +135,42 @@ def make_parquet(path: str, mb: int, seed: int = 0) -> int:
 
 # ---------------------------------------------------------------- configs
 
+def _stage_line(parser_or_reader, size: int) -> Optional[str]:
+    """Per-stage breakdown from the native engine stats (VERDICT r1 #7)."""
+    stats = getattr(parser_or_reader, "stats", None)
+    if stats is None:
+        return None
+    s = stats()
+    parse_key = "parse_busy_ns" if "parse_busy_ns" in s else "decode_busy_ns"
+    rd, pb, wall = s["reader_busy_ns"], s[parse_key], s["wall_ns"]
+    if not (rd and pb and wall):
+        return None
+    stage = parse_key.split("_")[0]
+    return (f"stages: read={rd / 1e9:.2f}s ({size / rd:.2f} GB/s) "
+            f"{stage}={pb / 1e9:.2f}s ({size / pb:.2f} GB/s summed) "
+            f"wall={wall / 1e9:.2f}s chunks={s['chunks']}")
+
+
 def bench_libsvm(mb: int) -> Dict:
-    from dmlc_tpu.data.row_iter import RowBlockIter
+    # config semantics: LibSVMParser -> RowBlockIter (drain into a
+    # materialized container, as BasicRowIter does)
+    from dmlc_tpu.data.parser import Parser
+    from dmlc_tpu.data.rowblock import RowBlockContainer
     path = f"{_TMP}.a1a.libsvm"
     size = make_libsvm(path, mb)
     t0 = time.perf_counter()
-    it = RowBlockIter.create(path, 0, 1, format="libsvm")
-    rows = nnz = 0
-    for b in it:
-        rows += b.size
-        nnz += b.nnz
+    p = Parser.create(path, 0, 1, format="libsvm")
+    c = RowBlockContainer(np.uint32)
+    while p.next():
+        c.push_block(p.value())
+    block = c.get_block()
+    rows, nnz = block.size, block.nnz
     dt = time.perf_counter() - t0
+    line = _stage_line(p, size)
+    if line:
+        _log(f"  {line}")
+    if hasattr(p, "destroy"):
+        p.destroy()
     return {"config": "libsvm_a1a", "gbps": size / dt / 1e9,
             "bytes": size, "rows": rows, "nnz": nnz,
             "hash": _content_hash(path, "libsvm")}
@@ -163,6 +188,9 @@ def bench_csv(mb: int) -> Dict:
         rows += b.size
         nnz += b.nnz
     dt = time.perf_counter() - t0
+    line = _stage_line(p, size)
+    if line:
+        _log(f"  {line}")
     if hasattr(p, "destroy"):
         p.destroy()
     return {"config": "csv_higgs", "gbps": size / dt / 1e9,
@@ -198,6 +226,9 @@ def bench_recordio(mb: int) -> Dict:
                 nrec += len(starts)
                 # hold the lease; views hashed outside the timed region
                 batches.append((data, (starts, ends), r.detach()))
+            line = _stage_line(r, size // 4)
+            if line and k == 0:
+                _log(f"  part0 {line}")
     else:
         from dmlc_tpu.io.input_split import InputSplit
         for k in range(4):
@@ -269,6 +300,10 @@ def bench_prefetch(mb: int, device: bool) -> Dict:
                 if ls is not None:
                     ls.release()
             in_flight.clear()
+        if k == 0:
+            line = _stage_line(p, size // nhosts)
+            if line:
+                _log(f"  part0 {line}")
         if hasattr(p, "destroy"):
             p.destroy()
     dt = time.perf_counter() - t0
@@ -290,6 +325,8 @@ def bench_parquet(mb: int) -> Dict:
         rows += b.size
         nnz += b.nnz
     dt = time.perf_counter() - t0
+    if hasattr(p, "destroy"):
+        p.destroy()
     return {"config": "parquet_columnar", "gbps": size / dt / 1e9,
             "bytes": size, "rows": rows, "nnz": nnz,
             "hash": _content_hash(path, "parquet", label_column="label")}

@@ -41,7 +41,8 @@ class ParquetParserParam(Parameter):
 
 class ParquetParser(Parser):
     def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1,
-                 index_dtype=np.uint32, **kwargs: Any):
+                 index_dtype=np.uint32, prefetch: bool = True,
+                 **kwargs: Any):
         if not _HAVE_ARROW:
             raise DMLCError(
                 "parquet parser requires pyarrow, which is not installed "
@@ -59,21 +60,47 @@ class ParquetParser(Parser):
         self._groups = groups[part_index::num_parts]
         self._pos = 0
         self._block: Optional[RowBlock] = None
+        # bytes_read reports COMPRESSED on-disk bytes (what GB/s is
+        # measured against), not inflated in-RAM table bytes
         self._bytes = 0
+        self._prefetch = None
+        if prefetch and len(self._groups) > 1:
+            from dmlc_tpu.data.threaded_iter import ThreadedIter
+            self._prefetch = ThreadedIter(max_capacity=2)
+            self._prefetch.init(self._produce, self._rewind)
+
+    # -- producer hooks (run on the prefetch thread)
+
+    def _rewind(self) -> None:
+        self._pos = 0
+
+    def _produce(self) -> Optional[RowBlock]:
+        if self._pos >= len(self._groups):
+            return None
+        fi, gi = self._groups[self._pos]
+        self._pos += 1
+        meta = self._files[fi].metadata.row_group(gi)
+        table = self._files[fi].read_row_group(gi)
+        self._bytes += sum(meta.column(c).total_compressed_size
+                           for c in range(meta.num_columns))
+        return self._table_to_block(table)
 
     def before_first(self) -> None:
-        self._pos = 0
+        if self._prefetch is not None:
+            self._prefetch.before_first()
+        else:
+            self._rewind()
         self._block = None
 
     def next(self) -> bool:
-        if self._pos >= len(self._groups):
-            return False
-        fi, gi = self._groups[self._pos]
-        self._pos += 1
-        table = self._files[fi].read_row_group(gi)
-        self._bytes += table.nbytes
-        self._block = self._table_to_block(table)
-        return True
+        self._block = (self._prefetch.next() if self._prefetch is not None
+                       else self._produce())
+        return self._block is not None
+
+    def destroy(self) -> None:
+        if self._prefetch is not None:
+            self._prefetch.destroy()
+            self._prefetch = None
 
     def _table_to_block(self, table) -> RowBlock:
         lcol, wcol = self.param.label_column, self.param.weight_column
@@ -104,5 +131,4 @@ class ParquetParser(Parser):
 @PARSER_REGISTRY.register("parquet", description="parquet/arrow columnar")
 def _make_parquet(**kwargs):
     kwargs.pop("engine", None)
-    kwargs.pop("prefetch", None)
     return ParquetParser(**kwargs)

@@ -98,12 +98,17 @@ class ThreadedIter(Generic[T]):
                 # loop back: epoch changed
 
     def _emit(self, epoch: int, kind: int, payload: Any) -> bool:
-        """Bounded put; returns False if the epoch went stale or destroyed."""
+        """Bounded put; returns False if the epoch went stale or destroyed.
+
+        Plain (untimed) waits: every state change that can unblock this —
+        consumer pop, before_first's epoch bump, destroy — notifies
+        _not_full under the lock, so no polling wake-ups are needed.
+        """
         with self._lock:
             while len(self._queue) >= self._cap:
                 if self._destroyed or self._epoch != epoch:
                     return False
-                self._not_full.wait(0.05)
+                self._not_full.wait()
             if self._destroyed or self._epoch != epoch:
                 return False
             self._queue.append((epoch, kind, payload))
@@ -123,7 +128,7 @@ class ThreadedIter(Generic[T]):
                 while not self._queue:
                     if self._destroyed:
                         return None
-                    self._not_empty.wait(0.1)
+                    self._not_empty.wait()  # _emit/destroy always notify
                 epoch, kind, payload = self._queue.pop(0)
                 self._not_full.notify()
                 if epoch != self._epoch:
@@ -151,11 +156,11 @@ class ThreadedIter(Generic[T]):
 
     def destroy(self) -> None:
         """Stop the producer and join (reference: Destroy/dtor)."""
-        self._destroyed = True
-        self._producer_wake.set()
         with self._lock:
+            self._destroyed = True
             self._not_full.notify_all()
             self._not_empty.notify_all()
+        self._producer_wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None

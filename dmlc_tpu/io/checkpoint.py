@@ -138,9 +138,28 @@ class ShardedCheckpoint:
         leaves, _ = _flatten(tree)
         d = self._step_dir(step)
         os.makedirs(d, exist_ok=True)
+        if pid == 0 and os.path.exists(d):
+            # re-saving an existing step (e.g. elastic restart with a
+            # smaller world): invalidate it NOW, and drop shard files of
+            # pids outside the new world so restore cannot mix worlds
+            commit = os.path.join(d, "COMMIT")
+            if os.path.exists(commit):
+                os.remove(commit)
+            world = jax.process_count()
+            for name in os.listdir(d):
+                if not name.startswith("shard-"):
+                    continue
+                try:
+                    owner = int(name.split("-", 1)[1].split(".", 1)[0])
+                except ValueError:
+                    continue
+                if owner >= world:
+                    os.remove(os.path.join(d, name))
+        self._barrier()  # nobody writes until the step is invalidated
         shard_path = os.path.join(d, f"shard-{pid}.bin")
         tmp = shard_path + ".tmp"
         index_entries = []  # byte index: restore seeks straight to records
+        offsets_ok = True   # stream must support tell() for a valid index
         with create_stream(tmp, "w") as s:
             ser.write_u32(s, _FORMAT_VERSION)
             ser.write_u64(s, len(leaves))
@@ -163,6 +182,8 @@ class ShardedCheckpoint:
                             "offset": off,
                             "nbytes": s.tell() - off,
                         })
+                    else:
+                        offsets_ok = False
         idx_path = os.path.join(d, f"shard-{pid}.idx.json")
         # publish order keeps every crash window restorable: drop any
         # stale index first (restore falls back to scanning the .bin),
@@ -172,10 +193,12 @@ class ShardedCheckpoint:
         if os.path.exists(idx_path):
             os.remove(idx_path)
         os.replace(tmp, shard_path)
-        with create_stream(idx_path + ".tmp", "w") as s:
-            json_dump({"entries": index_entries,
-                       "bin_size": os.path.getsize(shard_path)}, s)
-        os.replace(idx_path + ".tmp", idx_path)
+        if offsets_ok:  # a partial index would HIDE records; scan instead
+            with create_stream(idx_path + ".tmp", "w") as s:
+                json_dump({"version": _FORMAT_VERSION,
+                           "entries": index_entries,
+                           "bin_size": os.path.getsize(shard_path)}, s)
+            os.replace(idx_path + ".tmp", idx_path)
         if pid == 0:
             meta = {
                 "version": _FORMAT_VERSION,
@@ -324,8 +347,9 @@ class ShardedCheckpoint:
             if os.path.exists(idx_path):
                 with create_stream(idx_path, "r") as s:
                     idx = json_load(s)
-                if idx.get("bin_size") in (None,
-                                           os.path.getsize(bin_path)):
+                if (idx.get("bin_size") == os.path.getsize(bin_path)
+                        and idx.get("version", _FORMAT_VERSION)
+                        == _FORMAT_VERSION):
                     entries = [{
                         "file": bin_path,
                         "key": e["key"],

@@ -6,7 +6,7 @@ cflag<<29 | len, cflag ∈ {0 whole, 1 start, 2 middle, 3 end}),
 RecordIOReader::NextRecord, RecordIOChunkReader.
 
 Format contract (frozen by round-trip property tests in
-tests/test_recordio.py):
+tests/test_io.py):
 
 - A record is written as one or more *frames*. Each frame is
   ``magic(u32 LE) | lrec(u32 LE) | payload | pad-to-4B``, where
@@ -66,28 +66,31 @@ class RecordIOWriter:
         size = len(data)
         check(size < (1 << 29), "RecordIO: record too large (>= 2^29 bytes)")
         s = self._stream
-        # scan 4-byte-aligned positions for magic; each occurrence splits
-        # the record into frames with the magic removed
-        lower_align = (size >> 2) << 2
-        dptr = 0
-        i = data.find(_MAGIC_BYTES)
-        while i != -1 and i < lower_align:
-            if i % 4 == 0:
-                lrec = encode_lrec(1 if dptr == 0 else 2, i - dptr)
+        # scan 4-byte-aligned positions for the magic word; each aligned
+        # occurrence is removed and becomes a frame boundary (the reader
+        # re-inserts it when stitching) — only positions before the last
+        # aligned word can hold a full aligned magic
+        scan_end = (size >> 2) << 2
+        frame_start = 0  # start of the not-yet-written remainder
+        hit = data.find(_MAGIC_BYTES)
+        while hit != -1 and hit < scan_end:
+            if hit % 4 == 0:
+                lrec = encode_lrec(1 if frame_start == 0 else 2,
+                                   hit - frame_start)
                 s.write(_MAGIC_BYTES)
                 s.write(struct.pack("<I", lrec))
-                if i != dptr:
-                    s.write(data[dptr:i])
-                dptr = i + 4
+                if hit != frame_start:
+                    s.write(data[frame_start:hit])
+                frame_start = hit + 4
                 self.except_counter += 1
-                i = data.find(_MAGIC_BYTES, dptr)
+                hit = data.find(_MAGIC_BYTES, frame_start)
             else:
-                i = data.find(_MAGIC_BYTES, i + 1)
-        lrec = encode_lrec(3 if dptr != 0 else 0, size - dptr)
+                hit = data.find(_MAGIC_BYTES, hit + 1)
+        lrec = encode_lrec(3 if frame_start != 0 else 0, size - frame_start)
         s.write(_MAGIC_BYTES)
         s.write(struct.pack("<I", lrec))
-        if size != dptr:
-            s.write(data[dptr:size])
+        if size != frame_start:
+            s.write(data[frame_start:size])
         pad = (-size) % 4
         if pad:
             s.write(b"\x00" * pad)

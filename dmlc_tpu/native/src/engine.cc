@@ -28,6 +28,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <cmath>
 #include <deque>
@@ -38,6 +39,22 @@
 #include <thread>
 #include <type_traits>
 #include <vector>
+
+// Debug-build invariant checks (compiled in by -DDTP_DEBUG; the unit
+// tests build with it, the production .so does not — the checked
+// invariants are also pinned by tests either way).
+#ifdef DTP_DEBUG
+#define DTP_DCHECK(cond)                                                  \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "DTP_DCHECK failed: %s @ %s:%d\n", #cond,      \
+                   __FILE__, __LINE__);                                   \
+      std::abort();                                                       \
+    }                                                                     \
+  } while (0)
+#else
+#define DTP_DCHECK(cond) ((void)0)
+#endif
 
 namespace {
 
@@ -889,13 +906,20 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
                             std::string(q, s) + "'"};
       }
       if (!a->wide && idx <= UINT32_MAX) {
-        *ic++ = (uint32_t)idx;  // unchecked: capacity bounded above
+        // unchecked write: capacity bounded by the bytes/4+1 reserve
+        // above, valid while every feature token is >=4 bytes incl.
+        // separator ("i:v "). If that invariant is ever relaxed (e.g.
+        // defaulting empty values), this DCHECK catches the overflow
+        // in debug builds before it corrupts the heap.
+        DTP_DCHECK(ic < a->index32.data() + a->index32.cap);
+        *ic++ = (uint32_t)idx;
       } else {
         // rare >u32 index: sync cursor, widen, continue via checked path
         a->index32.n = (size_t)(ic - a->index32.data());
         a->push_index(idx);
         ic = a->index32.data() + a->index32.size();  // stays synced when wide
       }
+      DTP_DCHECK(vc < a->value.data() + a->value.cap);
       *vc++ = val;
       ++row_nnz;
       seen_feature = true;

@@ -257,6 +257,25 @@ class TestShardLocalRestore:
         np.testing.assert_array_equal(np.asarray(restored2["x"]),
                                       np.asarray(tree["x"]))
 
+    def test_resave_removes_stale_world_shards(self, tmp_path):
+        # regression: re-saving a step must drop shard files from pids
+        # outside the current world (elastic restart with fewer procs)
+        # and invalidate COMMIT while rewriting
+        tree, mesh, sh = self._tree()
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        d = ck.save(1, tree)
+        # plant shards from a departed pid 5 of a previous larger world
+        with open(os.path.join(d, "shard-5.bin"), "wb") as f:
+            f.write(b"stale")
+        with open(os.path.join(d, "shard-5.idx.json"), "w") as f:
+            json.dump({"entries": [], "bin_size": 5}, f)
+        ck.save(1, tree)  # re-save same step, world=1
+        assert not os.path.exists(os.path.join(d, "shard-5.bin"))
+        assert not os.path.exists(os.path.join(d, "shard-5.idx.json"))
+        restored, _ = ck.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+
     def test_replicated_target_restores(self, tmp_path):
         tree, mesh, _ = self._tree()
         ck = ShardedCheckpoint(str(tmp_path / "r"))
