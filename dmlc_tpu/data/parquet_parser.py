@@ -64,10 +64,10 @@ class ParquetParser(Parser):
         # measured against), not inflated in-RAM table bytes
         self._bytes = 0
         self._prefetch = None
-        if prefetch and len(self._groups) > 1:
-            from dmlc_tpu.data.threaded_iter import ThreadedIter
-            self._prefetch = ThreadedIter(max_capacity=2)
-            self._prefetch.init(self._produce, self._rewind)
+        # prefetch starts LAZILY on the first next(): consumers call
+        # before_first() first, which would discard (and re-read) any
+        # eagerly prefetched row groups
+        self._want_prefetch = prefetch and len(self._groups) > 1
 
     # -- producer hooks (run on the prefetch thread)
 
@@ -93,6 +93,10 @@ class ParquetParser(Parser):
         self._block = None
 
     def next(self) -> bool:
+        if self._prefetch is None and self._want_prefetch:
+            from dmlc_tpu.data.threaded_iter import ThreadedIter
+            self._prefetch = ThreadedIter(max_capacity=2)
+            self._prefetch.init(self._produce, self._rewind)
         self._block = (self._prefetch.next() if self._prefetch is not None
                        else self._produce())
         return self._block is not None

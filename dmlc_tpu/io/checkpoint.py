@@ -137,8 +137,9 @@ class ShardedCheckpoint:
         pid = jax.process_index()
         leaves, _ = _flatten(tree)
         d = self._step_dir(step)
+        existed = os.path.isdir(d)
         os.makedirs(d, exist_ok=True)
-        if pid == 0 and os.path.exists(d):
+        if pid == 0 and existed:
             # re-saving an existing step (e.g. elastic restart with a
             # smaller world): invalidate it NOW, and drop shard files of
             # pids outside the new world so restore cannot mix worlds

@@ -943,6 +943,14 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
   const bool fast_ok = !(d == '.' || d == '+' || d == '-' || d == 'e' ||
                          d == 'E' || (d >= '0' && d <= '9') || is_ws(d) ||
                          is_nl(d));
+  // hot per-cell buffers: worst-case bound (a feature cell is >=2 bytes
+  // incl. delimiter, "0,") reserved once so the loop writes through raw
+  // cursors with no per-push capacity check (same pattern as libsvm)
+  size_t bytes = (size_t)(e - b);
+  a->index32.reserve(a->index32.size() + bytes / 2 + 1);
+  a->value.reserve(a->value.size() + bytes / 2 + 1);
+  uint32_t* ic = a->index32.data() + a->index32.size();
+  float* vc = a->value.data() + a->value.size();
   // single pass, no line-end pre-scan (same structure as libsvm above)
   const char* p = b;
   while (p < e) {
@@ -988,8 +996,13 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
       } else if (col == cfg.weight_column) {
         weight = v;
       } else {
-        a->push_index((uint64_t)fidx);
-        a->value.push_back(v);
+        // unchecked writes: capacity bounded by the bytes/2+1 reserve
+        // (every cell is >=2 bytes incl. its delimiter); fidx is the
+        // in-row column ordinal, bounded far below 2^32 by chunk size
+        DTP_DCHECK(ic < a->index32.data() + a->index32.cap);
+        DTP_DCHECK(vc < a->value.data() + a->value.cap);
+        *ic++ = (uint32_t)fidx;
+        *vc++ = v;
         ++fidx;
         ++row_nnz;
       }
@@ -1023,6 +1036,8 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
     a->qid.push_back(-1);
     a->offset.push_back(a->offset.back() + (int64_t)row_nnz);
   }
+  a->index32.n = (size_t)(ic - a->index32.data());  // csv never widens
+  a->value.n = (size_t)(vc - a->value.data());
 }
 
 void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
