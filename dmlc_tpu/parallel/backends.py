@@ -25,6 +25,7 @@ __all__ = ["mpi_command", "slurm_script", "sge_script",
 
 def _rank_agnostic_envs(num_workers: int, coordinator: str) -> Dict[str, str]:
     """worker_envs minus the per-rank ids (schedulers inject those)."""
+    check(num_workers >= 1, "num_workers must be >= 1")
     envs = worker_envs(coordinator, num_workers, 0)
     envs.pop("DMLC_TPU_TASK_ID")
     envs.pop("DMLC_TASK_ID")
@@ -41,9 +42,13 @@ def mpi_command(num_workers: int, command: Sequence[str], coordinator: str,
     exports = " ".join(f"-x {k}={shlex.quote(v)}" for k, v in envs.items())
     hf = f"--hostfile {shlex.quote(host_file)} " if host_file else ""
     cmd_str = " ".join(shlex.quote(c) for c in command)
-    wrapper = ("sh -c 'DMLC_TPU_TASK_ID=$OMPI_COMM_WORLD_RANK "
-               "DMLC_TASK_ID=$OMPI_COMM_WORLD_RANK exec " + cmd_str + "'")
-    line = f"mpirun -n {num_workers} {hf}{exports} {wrapper}"
+    # single shlex.quote layer around the whole inner script: manual
+    # '...' wrapping broke on commands containing quotes (regression
+    # caught by tests/test_backends_exec.py stub execution)
+    inner = ("DMLC_TPU_TASK_ID=$OMPI_COMM_WORLD_RANK "
+             "DMLC_TASK_ID=$OMPI_COMM_WORLD_RANK exec " + cmd_str)
+    line = (f"mpirun -n {num_workers} {hf}{exports} "
+            f"sh -c {shlex.quote(inner)}")
     if submit:
         rc = subprocess.run(line, shell=True).returncode
         if rc:
@@ -60,13 +65,15 @@ def slurm_script(num_workers: int, command: Sequence[str], coordinator: str,
                         for k, v in envs.items())
     part = f"#SBATCH --partition={partition}\n" if partition else ""
     cmd_str = " ".join(shlex.quote(c) for c in command)
+    # one shlex.quote layer for the bash -c payload (see mpi_command)
+    inner = ("DMLC_TPU_TASK_ID=$SLURM_PROCID DMLC_TASK_ID=$SLURM_PROCID "
+             "exec " + cmd_str)
     return f"""#!/bin/bash
 #SBATCH --job-name={job_name}
 #SBATCH --ntasks={num_workers}
 #SBATCH --time={time_limit}
 {part}{exports}
-srun bash -c 'DMLC_TPU_TASK_ID=$SLURM_PROCID DMLC_TASK_ID=$SLURM_PROCID \\
-  exec {cmd_str}'
+srun bash -c {shlex.quote(inner)}
 """
 
 
