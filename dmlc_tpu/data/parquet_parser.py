@@ -37,6 +37,8 @@ except Exception:  # pragma: no cover - environment-dependent
 class ParquetParserParam(Parameter):
     label_column = field("", desc="column name holding the label; '' = none")
     weight_column = field("", desc="column name holding row weights")
+    sparse = field(False, desc="drop zero cells (sparse CSR output) "
+                               "instead of dense row-major fill")
 
 
 class ParquetParser(Parser):
@@ -119,6 +121,16 @@ class ParquetParser(Parser):
                  .astype(np.float32) if lcol else np.zeros(nrow, np.float32))
         weight = (table.column(wcol).to_numpy(zero_copy_only=False)
                   .astype(np.float32) if wcol else None)
+        if self.param.sparse:
+            # sparse column path: keep only non-zero cells, vectorized
+            mask = dense != 0
+            offset = np.zeros(nrow + 1, np.int64)
+            np.cumsum(mask.sum(axis=1), out=offset[1:])
+            rows_idx, cols_idx = np.nonzero(mask)
+            del rows_idx  # CSR order == row-major nonzero order
+            return RowBlock(offset=offset, label=label,
+                            index=cols_idx.astype(self.index_dtype),
+                            value=dense[mask], weight=weight)
         offset = np.arange(nrow + 1, dtype=np.int64) * ncol
         index = np.tile(np.arange(ncol, dtype=self.index_dtype), nrow)
         return RowBlock(offset=offset, label=label, index=index,

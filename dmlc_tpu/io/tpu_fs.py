@@ -49,6 +49,15 @@ def _inner_path(uri: URI) -> str:
     return uri.host + uri.name
 
 
+def local_path(uri: str) -> str:
+    """Map a (possibly tpu://) URI to its backing local path — the one
+    scheme-strip rule, shared by the native bindings and the device
+    ingest helpers."""
+    if uri.startswith(_SCHEME):
+        return _inner_path(URI(uri))
+    return uri
+
+
 class TPUSeekStream(SeekStream):
     """SeekStream over host bytes + device-chunk staging API."""
 
@@ -173,9 +182,7 @@ def recordio_device_batches(uri: str, part_index: int = 0,
     """
     import jax
     import numpy as np
-    if uri.startswith(_SCHEME):
-        u = URI(uri)
-        uri = _inner_path(u)
+    uri = local_path(uri)
     check(lookahead >= 1, "lookahead must be >= 1")
 
     plat = device.platform if device is not None else jax.default_backend()

@@ -99,6 +99,13 @@ def _get_lib():
     return get_lib()
 
 
+def _local_path(p: str) -> str:
+    """The engine reads raw local bytes; a tpu:// VFS path maps to its
+    backing local file (device staging happens at the consumer edge)."""
+    from dmlc_tpu.io.tpu_fs import local_path
+    return local_path(p)
+
+
 def native_parse_float32(token: bytes) -> np.float32:
     """Engine-side float parse (parity probe against the Python golden)."""
     lib = _get_lib()
@@ -159,7 +166,7 @@ class NativeTextParser(Parser):
             raise DMLCError(
                 "native engine does not support '#cache' URIs yet; "
                 "use engine='python' for cached splits")
-        files = list_split_files(uri)
+        files = [(_local_path(p), s) for p, s in list_split_files(uri)]
         for p, _ in files:
             check(os.path.exists(p),
                   f"native engine requires local files, got {p!r}")
@@ -336,7 +343,7 @@ class NativeRecordIOReader:
                  chunk_size: int = 8 << 20):
         lib = _get_lib()
         self.uri = uri
-        files = list_split_files(uri)
+        files = [(_local_path(p), s) for p, s in list_split_files(uri)]
         for p, _ in files:
             check(os.path.exists(p),
                   f"native recordio requires local files, got {p!r}")

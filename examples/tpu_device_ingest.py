@@ -65,8 +65,8 @@ def main() -> None:
 
     # --- sharded record batches straight to device + on-device reduce
     ndev = min(4, len(jax.devices()))
-    checksum = jnp.zeros((), jnp.uint32)
-    nrec = 0
+    checksum = 0  # host-side accumulation: per-part sums live on
+    nrec = 0      # DIFFERENT devices and must not be added under jit
     for part in range(ndev):
         dev = jax.devices()[part]
         for batch in recordio_device_batches(f"tpu://{path}", part, ndev,
@@ -82,10 +82,11 @@ def main() -> None:
             delta = (jnp.zeros(n + 1, jnp.int32)
                      .at[starts].add(1).at[ends].add(-1))
             covered = jnp.cumsum(delta[:-1]) > 0
-            checksum = checksum + jnp.sum(
-                jnp.where(covered, payload.astype(jnp.uint32), 0))
+            part_sum = jnp.sum(jnp.where(covered,
+                                         payload.astype(jnp.uint32), 0))
+            checksum = (checksum + int(part_sum)) % (1 << 32)
     expect = sum(sum(r) for r in records) % (1 << 32)
-    got = int(checksum) % (1 << 32)
+    got = checksum
     assert got == expect, (got, expect)
     assert nrec == len(records)
     print(f"recordio_device_batches: {nrec} records across {ndev} "

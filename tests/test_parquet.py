@@ -73,3 +73,41 @@ class TestParquetParser:
             path + "?format=parquet&label_column=label"))
         np.testing.assert_array_equal(
             block.label, table.column("label").to_numpy())
+
+
+class TestSparseColumnPath:
+    def test_sparse_drops_zeros_dense_parity(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from dmlc_tpu.data.parser import Parser
+        rng = np.random.RandomState(0)
+        dense = rng.rand(200, 6).astype(np.float32)
+        dense[dense < 0.5] = 0.0  # half the cells are zero
+        cols = {"label": pa.array((np.arange(200) % 2).astype(np.float32))}
+        for c in range(6):
+            cols[f"f{c}"] = pa.array(dense[:, c])
+        path = str(tmp_path / "s.parquet")
+        pq.write_table(pa.table(cols), path, row_group_size=64)
+
+        def blocks(**kw):
+            p = Parser.create(path, 0, 1, format="parquet",
+                              label_column="label", **kw)
+            out = [b for b in p]
+            if hasattr(p, "destroy"):
+                p.destroy()
+            return out
+
+        sp = blocks(sparse=True)
+        total_nnz = sum(b.nnz for b in sp)
+        assert total_nnz == int((dense != 0).sum())
+        # per-row reconstruction matches the dense matrix
+        row = 0
+        for b in sp:
+            for r in b:
+                full = np.zeros(6, np.float32)
+                full[r.index] = r.value
+                np.testing.assert_array_equal(full, dense[row])
+                row += 1
+        assert row == 200
+        dn = blocks(sparse=False)
+        assert sum(b.nnz for b in dn) == 200 * 6

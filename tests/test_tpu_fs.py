@@ -155,3 +155,38 @@ class TestRecordIOToDevice:
             ends = np.asarray(batch["ends"])
             got += [bytes(payload[s:e]) for s, e in zip(starts, ends)]
         assert got == recs
+
+
+class TestParsersOverTPUScheme:
+    def test_native_and_python_parse_tpu_uri(self, tmp_path):
+        from dmlc_tpu.data.parser import Parser
+        from dmlc_tpu.data.rowblock import RowBlockContainer
+        p = tmp_path / "t.libsvm"
+        p.write_bytes(b"".join(f"{i%2} {i}:1.5\n".encode()
+                               for i in range(2000)))
+
+        def hsh(uri, engine):
+            c = RowBlockContainer(np.uint32)
+            pr = Parser.create(uri, 0, 1, format="libsvm", engine=engine)
+            for b in pr:
+                c.push_block(b)
+            if hasattr(pr, "destroy"):
+                pr.destroy()
+            return c.get_block().content_hash()
+
+        plain = hsh(str(p), "python")
+        assert hsh(f"tpu://{p}", "python") == plain
+        assert hsh(f"tpu://{p}", "native") == plain
+
+    def test_native_recordio_tpu_uri(self, tmp_path, rng):
+        from dmlc_tpu.io.recordio import RecordIOWriter
+        from dmlc_tpu.native.bindings import NativeRecordIOReader
+        path = tmp_path / "x.rec"
+        recs = [rng.bytes(rng.randint(1, 500)) for _ in range(50)]
+        with open(path, "wb") as fh:
+            w = RecordIOWriter(fh)
+            for r in recs:
+                w.write_record(r)
+        r = NativeRecordIOReader(f"tpu://{path}", 0, 1)
+        assert list(r.records()) == recs
+        r.destroy()
