@@ -99,11 +99,17 @@ def _get_lib():
     return get_lib()
 
 
-def _local_path(p: str) -> str:
-    """The engine reads raw local bytes; a tpu:// VFS path maps to its
-    backing local file (device staging happens at the consumer edge)."""
+def _local_split_files(uri: str):
+    """[(local_path, size)] for a split URI. The engine reads raw local
+    bytes, so tpu:// VFS paths map to their backing files (device
+    staging happens at the consumer edge); anything else must exist
+    locally."""
     from dmlc_tpu.io.tpu_fs import local_path
-    return local_path(p)
+    files = [(local_path(p), s) for p, s in list_split_files(uri)]
+    for p, _ in files:
+        check(os.path.exists(p),
+              f"native engine requires local files, got {p!r}")
+    return files
 
 
 def native_parse_float32(token: bytes) -> np.float32:
@@ -166,10 +172,7 @@ class NativeTextParser(Parser):
             raise DMLCError(
                 "native engine does not support '#cache' URIs yet; "
                 "use engine='python' for cached splits")
-        files = [(_local_path(p), s) for p, s in list_split_files(uri)]
-        for p, _ in files:
-            check(os.path.exists(p),
-                  f"native engine requires local files, got {p!r}")
+        files = _local_split_files(uri)
         paths = (C.c_char_p * len(files))(
             *[p.encode() for p, _ in files])
         sizes = (C.c_int64 * len(files))(*[s for _, s in files])
@@ -190,6 +193,22 @@ class NativeTextParser(Parser):
                 f"{lib.dtp_last_error().decode()}")
         self._block: Optional[RowBlock] = None
         self._lease: Optional[BlockLease] = None
+        self._init_outparams()
+
+    def _init_outparams(self) -> None:
+        # out-params allocated once; the C call overwrites them per block
+        self._o = (C.c_void_p(),             # block lease
+                   C.POINTER(C.c_int64)(),   # offset
+                   C.POINTER(C.c_float)(),   # label
+                   C.POINTER(C.c_float)(),   # weight
+                   C.POINTER(C.c_int64)(),   # qid
+                   C.POINTER(C.c_uint32)(),  # index32
+                   C.POINTER(C.c_uint64)(),  # index64
+                   C.POINTER(C.c_float)(),   # value
+                   C.POINTER(C.c_int64)(),   # field
+                   C.c_int64(),              # nnz
+                   C.c_int(), C.c_int(), C.c_int())
+        self._refs = tuple(C.byref(x) for x in self._o)
 
     # format knobs; subclasses override
     _indexing_mode = 0
@@ -220,22 +239,9 @@ class NativeTextParser(Parser):
         if self._lease is not None:  # standard RowBlock lifetime contract
             self._lease.release()
             self._lease = None
-        block = C.c_void_p()
-        offset = C.POINTER(C.c_int64)()
-        label = C.POINTER(C.c_float)()
-        weight = C.POINTER(C.c_float)()
-        qid = C.POINTER(C.c_int64)()
-        index32 = C.POINTER(C.c_uint32)()
-        index64 = C.POINTER(C.c_uint64)()
-        value = C.POINTER(C.c_float)()
-        field = C.POINTER(C.c_int64)()
-        nnz = C.c_int64()
-        hw, hq, hf = C.c_int(), C.c_int(), C.c_int()
-        rows = self._lib.dtp_parser_next(
-            self._handle, C.byref(block), C.byref(offset), C.byref(label),
-            C.byref(weight), C.byref(qid), C.byref(index32), C.byref(index64),
-            C.byref(value), C.byref(field), C.byref(nnz), C.byref(hw),
-            C.byref(hq), C.byref(hf))
+        rows = self._lib.dtp_parser_next(self._handle, *self._refs)
+        (block, offset, label, weight, qid, index32, index64, value,
+         field, nnz, hw, hq, hf) = self._o
         if rows < 0:
             self._block = None  # stale views must not outlive the error
             raise DMLCError(
@@ -343,10 +349,7 @@ class NativeRecordIOReader:
                  chunk_size: int = 8 << 20):
         lib = _get_lib()
         self.uri = uri
-        files = [(_local_path(p), s) for p, s in list_split_files(uri)]
-        for p, _ in files:
-            check(os.path.exists(p),
-                  f"native recordio requires local files, got {p!r}")
+        files = _local_split_files(uri)
         paths = (C.c_char_p * len(files))(*[p.encode() for p, _ in files])
         sizes = (C.c_int64 * len(files))(*[s for _, s in files])
         self._lib = lib

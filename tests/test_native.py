@@ -261,6 +261,7 @@ class TestTruncatedFile:
         parser._handle = h
         parser._block = None
         parser._lease = None
+        parser._init_outparams()
         parser.index_dtype = np.dtype(np.uint32)
         with pytest.raises(DMLCError, match="short read|truncated"):
             while parser.next():

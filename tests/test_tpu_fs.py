@@ -157,6 +157,13 @@ class TestRecordIOToDevice:
         assert got == recs
 
 
+def _native_built() -> bool:
+    from dmlc_tpu import native
+    return native.native_available()
+
+
+@pytest.mark.skipif(not _native_built(),
+                    reason="native engine not built")
 class TestParsersOverTPUScheme:
     def test_native_and_python_parse_tpu_uri(self, tmp_path):
         from dmlc_tpu.data.parser import Parser
