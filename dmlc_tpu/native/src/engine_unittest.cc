@@ -222,6 +222,86 @@ static void test_shard_coverage() {
   }
 }
 
+// recordio shard coverage: every record lands in exactly one part, for
+// any nparts/chunk size, incl. multi-frame (escaped-magic) records
+// (reference invariant: unittest_inputsplit, applied to recordio_split)
+static void test_recordio_shard_coverage() {
+  std::string dir = "/tmp/dtp_engine_unittest_rec";
+  std::system(("rm -rf " + dir + " && mkdir -p " + dir).c_str());
+  srand(11);
+  std::vector<FileEntry> files;
+  std::vector<std::string> all_records;
+  for (int f = 0; f < 2; ++f) {
+    std::string path = dir + "/part" + std::to_string(f) + ".rec";
+    std::ofstream out(path, std::ios::binary);
+    for (int i = 0; i < 300; ++i) {
+      // payload tagged with a global ordinal; occasionally embed the
+      // aligned magic so escaping paths run
+      std::string payload(8, '\0');
+      uint64_t tag = all_records.size();
+      std::memcpy(payload.data(), &tag, 8);
+      if (i % 9 == 0) payload.append((const char*)&kRecIOMagic, 4);
+      payload.append(rand() % 200, 'x');
+      all_records.push_back(payload);
+      // write with escaping (mirror of the python writer contract)
+      size_t n = payload.size();
+      size_t scan_end = (n >> 2) << 2;
+      size_t start = 0;
+      for (size_t pos = 0; pos + 4 <= scan_end; pos += 4) {
+        if (load_u32le(payload.data() + pos) == kRecIOMagic) {
+          uint32_t lrec =
+              ((start == 0 ? 1u : 2u) << 29) | (uint32_t)(pos - start);
+          out.write((const char*)&kRecIOMagic, 4);
+          out.write((const char*)&lrec, 4);
+          out.write(payload.data() + start, pos - start);
+          size_t pad = (4 - ((pos - start) & 3)) & 3;
+          out.write("\0\0\0", pad);
+          start = pos + 4;
+        }
+      }
+      uint32_t lrec =
+          ((start ? 3u : 0u) << 29) | (uint32_t)(n - start);
+      out.write((const char*)&kRecIOMagic, 4);
+      out.write((const char*)&lrec, 4);
+      out.write(payload.data() + start, n - start);
+      size_t pad = (4 - ((n - start) & 3)) & 3;
+      out.write("\0\0\0", pad);
+    }
+    out.close();
+    std::ifstream sz(path, std::ios::ate | std::ios::binary);
+    files.push_back({path, (int64_t)sz.tellg()});
+  }
+  for (int nparts : {1, 2, 5}) {
+    for (int64_t chunk : {1, 1 << 20}) {
+      std::multiset<uint64_t> seen;
+      for (int part = 0; part < nparts; ++part) {
+        RecordIOShardReader r(files, part, nparts, chunk);
+        std::string buf;
+        while (r.NextChunk(&buf)) {
+          RecBatch b;
+          b.data = std::move(buf);
+          DecodeRecordIOChunkInPlace(&b);
+          for (size_t k = 0; k < b.starts.size(); ++k) {
+            uint64_t tag;
+            CHECK_TRUE(b.ends.data()[k] - b.starts.data()[k] >= 8);
+            std::memcpy(&tag, b.data.data() + b.starts.data()[k], 8);
+            // stitched payload must match what was written
+            std::string got(b.data.data() + b.starts.data()[k],
+                            (size_t)(b.ends.data()[k] - b.starts.data()[k]));
+            CHECK_TRUE(tag < all_records.size());
+            CHECK_TRUE(got == all_records[(size_t)tag]);
+            seen.insert(tag);
+          }
+          buf = std::move(b.data);
+        }
+      }
+      CHECK_EQ_(seen.size(), all_records.size());
+      CHECK_TRUE(std::set<uint64_t>(seen.begin(), seen.end()).size() ==
+                 seen.size());
+    }
+  }
+}
+
 int main() {
   test_digit_run_len();
   test_parse_digits_k();
@@ -230,6 +310,7 @@ int main() {
   test_buf();
   test_arena_widen();
   test_shard_coverage();
+  test_recordio_shard_coverage();
   if (g_failures) {
     std::cerr << g_failures << " native unit-test failures\n";
     return 1;
