@@ -124,6 +124,15 @@ def main() -> None:
     # improving (or a time budget runs out) so the recorded number is
     # the steady-state hardware rate, not a throttled window
     budget_s = float(os.environ.get("DMLC_TPU_BENCH_BUDGET_S", "60"))
+    # DMLC_TPU_TRACE=<dir>: dump a jax.profiler device timeline of one
+    # epoch (utils.profiler.trace) for offline inspection
+    trace_dir = os.environ.get("DMLC_TPU_TRACE")
+    if trace_dir:
+        from dmlc_tpu.utils.profiler import trace
+        with trace("bench_epoch", log_dir=trace_dir):
+            epoch()
+        log(f"jax.profiler trace written to {trace_dir}")
+
     best = None
     best_stats = None
     t_start = time.perf_counter()
