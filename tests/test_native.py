@@ -87,6 +87,18 @@ class TestEngineParity:
                       weight_column=1)
         assert g.content_hash() == n.content_hash()
 
+    @pytest.mark.parametrize("delim", ["\t", ";", "|", " "])
+    def test_csv_delimiter_parity(self, tmp_path, rng, delim):
+        rows = [delim.join(f"{rng.randn():.5g}" for _ in range(6))
+                for _ in range(300)]
+        p = tmp_path / "d.csv"
+        p.write_bytes(("\n".join(rows) + "\n").encode())
+        g = parse_all(str(p), "python", fmt="csv", label_column=0,
+                      delimiter=delim)
+        n = parse_all(str(p), "native", fmt="csv", label_column=0,
+                      delimiter=delim)
+        assert g.content_hash() == n.content_hash(), repr(delim)
+
     def test_libfm_parity(self, tmp_path, rng):
         lines = []
         for i in range(300):
