@@ -1,8 +1,11 @@
-"""Benchmark: libsvm parse-to-HBM GB/s/chip (BASELINE.json config 4 shape).
+"""Benchmark: libsvm parse-to-HBM GB/s/chip — the headline driver metric.
 
-Measures the full pipeline on this host's accelerator: sharded read →
-native C++ parse → CSR RowBlock → jax.device_put into device memory,
-with transfers overlapping parse. Prints exactly ONE JSON line:
+Measures the full single-chip pipeline on this host's accelerator:
+criteo-shaped libsvm (one shard — per-chip throughput is the metric;
+the multi-part/multi-host shard shape is bench_suite config 4, which
+runs all parts with concurrent pipelines) → native C++ parse → zero-copy
+CSR views → async jax.device_put into device memory, transfers riding
+under parse via detached leases. Prints exactly ONE JSON line:
 {"metric", "value", "unit", "vs_baseline"} — vs_baseline is value / 2.0
 (the BASELINE.json target of 2 GB/s/chip; the reference publishes no
 numbers of its own, see BASELINE.md).
