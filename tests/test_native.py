@@ -146,6 +146,30 @@ class TestEngineParity:
             assert g.content_hash() == n.content_hash(), f"mode={mode}"
 
 
+class TestEngineAutoFallback:
+    def test_cache_uri_falls_back_to_python(self, tmp_path):
+        """engine='auto' must serve '#cache' URIs via the Python golden
+        (the native engine declines them) — and the cached replay still
+        matches the direct parse."""
+        data = b"".join(f"{i % 2} {i}:1.5\n".encode() for i in range(500))
+        p = tmp_path / "c.libsvm"
+        p.write_bytes(data)
+        cache = tmp_path / "cachefile"
+        direct = parse_all(str(p), "auto")
+        cached1 = parse_all(f"{p}#{cache}", "auto")   # builds the cache
+        cached2 = parse_all(f"{p}#{cache}", "auto")   # replays it
+        assert direct.content_hash() == cached1.content_hash()
+        assert direct.content_hash() == cached2.content_hash()
+        assert cache.exists() or any(
+            f.name.startswith(cache.name) for f in tmp_path.iterdir())
+
+    def test_native_refuses_cache_uri_explicitly(self, tmp_path):
+        p = tmp_path / "c2.libsvm"
+        p.write_bytes(b"1 1:1\n")
+        with pytest.raises(DMLCError, match="cache"):
+            parse_all(f"{p}#{p}.cache", "native")
+
+
 class TestNativeErrors:
     def test_bad_token_raises(self, tmp_path):
         p = tmp_path / "bad.libsvm"
@@ -207,6 +231,36 @@ class TestFloatParseContract:
                 continue
             got = native_parse_float32(t)
             assert np.float32(golden).tobytes() == np.float32(got).tobytes(), t
+
+    def test_exhaustive_short_tokens(self):
+        """EVERY token of length <= 3 over the decimal charset parses
+        (or rejects) identically across engines — exhaustive closure of
+        the short-token space where tokenizer edge cases live."""
+        from dmlc_tpu.native.bindings import native_parse_float32
+        from dmlc_tpu.data.strtonum import parse_float32
+        chars = b"0123456789.eE+-"
+        tokens = [bytes([a]) for a in chars]
+        tokens += [bytes([a, b]) for a in chars for b in chars]
+        tokens += [bytes([a, b, c]) for a in chars for b in chars
+                   for c in chars]
+        diverged = []
+        for t in tokens:
+            try:
+                golden = parse_float32(t)
+                gold_ok = True
+            except (ValueError, OverflowError):
+                gold_ok = False
+            try:
+                got = native_parse_float32(t)
+                nat_ok = True
+            except ValueError:
+                nat_ok = False
+            if gold_ok != nat_ok:
+                diverged.append((t, gold_ok, nat_ok))
+            elif gold_ok and np.float32(golden).tobytes() != \
+                    np.float32(got).tobytes():
+                diverged.append((t, float(golden), float(got)))
+        assert not diverged, f"{len(diverged)} divergent: {diverged[:10]}"
 
     def test_underscore_rejected_both(self):
         from dmlc_tpu.native.bindings import native_parse_float32
