@@ -1,0 +1,48 @@
+"""Smoke-run every example as a real subprocess — examples are the
+user-facing contract and must not rot. Each runs on the CPU backend
+(virtual devices) exactly as examples/README.md documents."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+REPO = os.path.dirname(EXAMPLES)
+
+
+def _run(name, extra_env=None, timeout=420):
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "PYTHONPATH": os.pathsep.join(
+               [REPO] + os.environ.get("PYTHONPATH", "").split(os.pathsep))}
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name)],
+        env=env, cwd=EXAMPLES, capture_output=True, text=True,
+        timeout=timeout)
+
+
+@pytest.mark.slow
+class TestExamples:
+    def test_data_pipeline(self):
+        r = _run("data_pipeline.py")
+        assert r.returncode == 0, r.stderr[-3000:]
+
+    def test_train_sparse_linear(self):
+        r = _run("train_sparse_linear.py")
+        assert r.returncode == 0, r.stderr[-3000:]
+
+    def test_tpu_device_ingest(self):
+        r = _run("tpu_device_ingest.py")
+        assert r.returncode == 0, r.stderr[-3000:]
+        assert "checksum OK" in r.stdout
+
+    def test_distributed_launch(self):
+        r = _run("distributed_launch.py")
+        assert r.returncode == 0, r.stderr[-3000:]
+        assert "parent restored" in r.stdout
