@@ -151,7 +151,11 @@ def main() -> None:
         since_improved = 0 if improved_enough else since_improved + 1
         i += 1
         elapsed = time.perf_counter() - t_start
-        if i >= 3 and (since_improved >= 3 or elapsed > budget_s):
+        # keep sampling at least ~1/3 of the budget: the burstable CPU
+        # throttles in multi-second stretches, and converging inside one
+        # would lock in a slow window
+        if i >= 3 and ((since_improved >= 3 and elapsed > budget_s / 3)
+                       or elapsed > budget_s):
             break
     dt = best
     if best_stats:
