@@ -21,6 +21,11 @@
 // owned by the handle, valid until dtp_block_release or destroy, so the
 // Python side wraps them zero-copy and overlaps transfers with parse.
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <algorithm>
 #include <atomic>
 #include <charconv>
@@ -466,7 +471,10 @@ class ShardReaderBase {
     for (auto& f : files_) prefix_.push_back(prefix_.back() + f.size);
     total_ = prefix_.back();
   }
-  virtual ~ShardReaderBase() { CloseFile(); }
+  virtual ~ShardReaderBase() {
+    CloseFile();
+    UnmapAll();
+  }
 
   // subclasses call this after their vtable is complete (boundary()
   // invokes the format hooks)
@@ -488,10 +496,13 @@ class ShardReaderBase {
     cur_ = begin_;
     leftover_.clear();
     bytes_read_ = 0;
+    // mappings (if any) survive Reset: epochs re-walk the same views
   }
 
   int64_t total_size() const { return total_; }
   int64_t bytes_read() const { return bytes_read_; }
+
+  enum ViewStatus { kView, kEnd, kUnavailable };
 
   // Next buffer of whole records; false at end of shard. Builds into
   // *out in place so a pooled buffer keeps its capacity across chunks
@@ -551,10 +562,52 @@ class ShardReaderBase {
   // length of the longest whole-record prefix of buf (0 = none complete)
   virtual size_t FindLastRecordEnd(const std::string& buf) = 0;
 
- private:
+ protected:
   void CloseFile() {
     if (fp_) { fclose(fp_); fp_ = nullptr; }
   }
+
+  // lazily map file i read-only; nullptr (and a sticky failure flag)
+  // when the file is not a mappable regular file of the promised size
+  // (e.g. shrank since listing — buffered mode detects that as a
+  // short read instead of SIGBUSing through a mapping)
+  const char* MapFile(int i) {
+    if (maps_.empty()) maps_.assign(files_.size(), nullptr);
+    if (maps_[(size_t)i]) return (const char*)maps_[(size_t)i];
+    size_t len = (size_t)(prefix_[i + 1] - prefix_[i]);
+    int fd = open(files_[(size_t)i].path.c_str(), O_RDONLY);
+    if (fd < 0) {
+      mmap_failed_ = true;
+      return nullptr;
+    }
+    struct stat st;
+    if (fstat(fd, &st) != 0 || !S_ISREG(st.st_mode) ||
+        (size_t)st.st_size < len) {
+      close(fd);
+      mmap_failed_ = true;
+      return nullptr;
+    }
+    void* m = mmap(nullptr, len, PROT_READ, MAP_PRIVATE, fd, 0);
+    close(fd);
+    if (m == MAP_FAILED) {
+      mmap_failed_ = true;
+      return nullptr;
+    }
+    madvise(m, len, MADV_SEQUENTIAL);
+    maps_[(size_t)i] = m;
+    return (const char*)m;
+  }
+
+  void UnmapAll() {
+    for (size_t i = 0; i < maps_.size(); ++i)
+      if (maps_[i])
+        munmap(maps_[i], (size_t)(prefix_[i + 1] - prefix_[i]));
+    maps_.clear();
+  }
+
+  std::vector<void*> maps_;
+  bool mmap_failed_ = false;
+
 
   int FileIndexOf(int64_t gpos) const {
     // last i with prefix_[i] <= gpos
@@ -608,7 +661,66 @@ class TextShardReader : public ShardReaderBase {
   TextShardReader(std::vector<FileEntry> files, int64_t part, int64_t nparts,
                   int64_t chunk_bytes)
       : ShardReaderBase(std::move(files), chunk_bytes, /*align=*/1) {
+    const char* no_mmap = getenv("DMLC_TPU_NO_MMAP");
+    if (no_mmap && no_mmap[0] == '1') mmap_failed_ = true;
     InitPartition(part, nparts);
+  }
+
+  // Zero-copy chunk: *p/*n view the mmap'd file directly, cut at a TEXT
+  // record boundary (this method lives on TextShardReader because the
+  // cut rule is the newline rule — RecordIO's in-place stitch also
+  // MUTATES its chunks and must never see a read-only view). Views stay
+  // valid until the reader is destroyed. kUnavailable when the current
+  // file cannot be safely mapped (or DMLC_TPU_NO_MMAP=1): the caller
+  // switches to buffered NextChunk, which resumes from the same shared
+  // cursor — view chunks always end on a record boundary.
+  //
+  // Residual risk, stated honestly: the fstat size check catches files
+  // that shrank BEFORE mapping (that path stays a clean EngineError via
+  // the buffered fallback), but a file truncated by another process
+  // AFTER mapping makes later page touches SIGBUS — inherent to mmap
+  // (every mapped-IO reader shares it). Set DMLC_TPU_NO_MMAP=1 for
+  // environments where inputs mutate mid-run.
+  ViewStatus NextChunkView(const char** p, size_t* n) {
+    if (mmap_failed_) return kUnavailable;
+    if (cur_ >= end_) return kEnd;
+    int i = FileIndexOf(cur_);
+    const char* base = MapFile(i);
+    if (!base) return kUnavailable;
+    int64_t avail_end = std::min(prefix_[i + 1], end_);
+    int64_t off = cur_ - prefix_[i];
+    int64_t limit = avail_end - prefix_[i];
+    int64_t target = std::min<int64_t>(off + chunk_bytes_, limit);
+    int64_t cut = limit;
+    if (target < limit) {
+      // cut after the last newline in [off, target); a '\r' can only
+      // beat the last '\n' if it sits after it, so scan the tail only
+      // (avoids a full extra backward pass on LF-only data); if a
+      // record is longer than a chunk, extend forward to the next
+      // newline byte
+      const char* nl = (const char*)memrchr(base + off, '\n',
+                                            (size_t)(target - off));
+      const char* tail = nl ? nl + 1 : base + off;
+      const char* cr = (const char*)memrchr(
+          tail, '\r', (size_t)(base + target - tail));
+      const char* best = cr ? cr : nl;
+      if (best) {
+        cut = (best - base) + 1;
+      } else {
+        const void* fwd =
+            memchr(base + target, '\n', (size_t)(limit - target));
+        const void* fwr =
+            memchr(base + target, '\r', (size_t)(limit - target));
+        const char* first = (const char*)(
+            fwd && fwr ? std::min(fwd, fwr) : (fwd ? fwd : fwr));
+        cut = first ? (first - base) + 1 : limit;
+      }
+    }
+    *p = base + off;
+    *n = (size_t)(cut - off);
+    bytes_read_ += (int64_t)*n;
+    cur_ = prefix_[i] + cut;
+    return kView;
   }
 
  protected:
@@ -1101,10 +1213,9 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
 // per-thread containers. Chunks are already cut at record boundaries
 // by TextShardReader, and the ordered output queue restores chunk
 // order, so output stays byte-identical at any thread count.
-void ParseChunkInto(const std::string& chunk, const ParserConfig& cfg,
+void ParseChunkInto(const char* b, size_t len, const ParserConfig& cfg,
                     std::atomic<long>* ncol_atom, CSRArena* out) {
-  const char* b = chunk.data();
-  const char* e = b + chunk.size();
+  const char* e = b + len;
   switch (cfg.format) {
     case Format::kLibSVM:
       ParseLibSVMSlice(b, e, out);
@@ -1192,7 +1303,12 @@ class BoundedQueue {
 
 struct ChunkItem {
   uint64_t seq = 0;
-  std::string data;
+  std::string data;            // owned (buffered mode)
+  const char* view = nullptr;  // borrowed mmap view (text fast path);
+  size_t view_len = 0;         // valid while the reader lives
+
+  const char* begin() const { return view ? view : data.data(); }
+  size_t size() const { return view ? view_len : data.size(); }
 };
 
 struct BlockItem {
@@ -1369,11 +1485,23 @@ struct ParserHandle {
     reader_thread = std::make_unique<std::thread>([this] {
       uint64_t seq = 0;
       try {
+        bool try_views = true;  // mmap fast path until a file declines
         while (true) {
           ChunkItem item;
-          item.data = GetChunkBuf();
           int64_t t0 = now_ns();
-          bool more = reader->NextChunk(&item.data);
+          bool more;
+          if (try_views) {
+            auto st = reader->NextChunkView(&item.view, &item.view_len);
+            if (st == ShardReaderBase::kUnavailable) {
+              try_views = false;  // hand off to buffered at same cursor
+              stats.reader_busy_ns += now_ns() - t0;
+              continue;
+            }
+            more = (st == ShardReaderBase::kView);
+          } else {
+            item.data = GetChunkBuf();
+            more = reader->NextChunk(&item.data);
+          }
           stats.reader_busy_ns += now_ns() - t0;
           if (!more) break;
           item.seq = seq++;
@@ -1402,7 +1530,8 @@ struct ParserHandle {
                 std::chrono::milliseconds(test_delay_ms));
           try {
             auto arena = GetArena();
-            ParseChunkInto(item.data, cfg, &ncol, arena.get());
+            ParseChunkInto(item.begin(), item.size(), cfg, &ncol,
+                           arena.get());
             out.arena = std::move(arena);
           } catch (const EngineError& err) {
             out.error = err.msg;
@@ -1410,7 +1539,7 @@ struct ParserHandle {
             out.error = ex.what();
           }
           stats.parse_busy_ns += now_ns() - t0;
-          RecycleChunkBuf(std::move(item.data));
+          if (!item.view) RecycleChunkBuf(std::move(item.data));
           if (!blocks->Push(item.seq, std::move(out))) break;
         }
         blocks->ProducerDone();
