@@ -222,6 +222,40 @@ static void test_shard_coverage() {
   }
 }
 
+// mmap view mode must yield the same byte stream as buffered mode for
+// every (part, chunk size) — chunks may be cut differently, but the
+// concatenation per shard is identical
+static void test_view_buffered_parity() {
+  std::string dir = "/tmp/dtp_engine_unittest";  // reuse shard fixture
+  std::vector<FileEntry> files;
+  for (int f = 0; f < 2; ++f) {
+    std::string path = dir + "/part" + std::to_string(f) + ".libsvm";
+    std::ifstream sz(path, std::ios::ate | std::ios::binary);
+    CHECK_TRUE(sz.good());
+    files.push_back({path, (int64_t)sz.tellg()});
+  }
+  for (int nparts : {1, 3}) {
+    for (int64_t chunk : {1, 1 << 20}) {
+      for (int part = 0; part < nparts; ++part) {
+        TextShardReader buffered(files, part, nparts, chunk);
+        TextShardReader viewed(files, part, nparts, chunk);
+        std::string a, b, buf;
+        while (buffered.NextChunk(&buf)) a += buf;
+        const char* p;
+        size_t n;
+        while (true) {
+          auto st = viewed.NextChunkView(&p, &n);
+          CHECK_TRUE(st != ShardReaderBase::kUnavailable);
+          if (st != ShardReaderBase::kView) break;
+          b.append(p, n);
+        }
+        CHECK_TRUE(a == b);
+        CHECK_EQ_(buffered.bytes_read(), viewed.bytes_read());
+      }
+    }
+  }
+}
+
 // recordio shard coverage: every record lands in exactly one part, for
 // any nparts/chunk size, incl. multi-frame (escaped-magic) records
 // (reference invariant: unittest_inputsplit, applied to recordio_split)
@@ -310,6 +344,7 @@ int main() {
   test_buf();
   test_arena_widen();
   test_shard_coverage();
+  test_view_buffered_parity();  // needs test_shard_coverage's fixture
   test_recordio_shard_coverage();
   if (g_failures) {
     std::cerr << g_failures << " native unit-test failures\n";
