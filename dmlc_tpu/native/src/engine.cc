@@ -470,6 +470,10 @@ class ShardReaderBase {
     prefix_.push_back(0);
     for (auto& f : files_) prefix_.push_back(prefix_.back() + f.size);
     total_ = prefix_.back();
+    // mmap kill-switch honored by EVERY reader format (see the
+    // NextChunkView comment for the truncation-after-mapping risk)
+    const char* no_mmap = getenv("DMLC_TPU_NO_MMAP");
+    if (no_mmap && no_mmap[0] == '1') mmap_failed_ = true;
   }
   virtual ~ShardReaderBase() {
     CloseFile();
@@ -503,6 +507,40 @@ class ShardReaderBase {
   int64_t bytes_read() const { return bytes_read_; }
 
   enum ViewStatus { kView, kEnd, kUnavailable };
+
+  // Zero-copy chunk: *p/*n view the mmap'd file directly, cut at a
+  // record boundary by the per-format CutViewChunk hook. Views are
+  // READ-ONLY and stay valid until the reader is destroyed.
+  // kUnavailable when the current file cannot be safely mapped (or
+  // DMLC_TPU_NO_MMAP=1): the caller switches to buffered NextChunk,
+  // which resumes from the same shared cursor — view chunks always end
+  // on a record boundary, so the hand-off is seamless.
+  //
+  // Residual risk, stated honestly: the fstat size check catches files
+  // that shrank BEFORE mapping (that path stays a clean EngineError via
+  // the buffered fallback), but a file truncated by another process
+  // AFTER mapping makes later page touches SIGBUS — inherent to mmap
+  // (every mapped-IO reader shares it). Set DMLC_TPU_NO_MMAP=1 for
+  // environments where inputs mutate mid-run.
+  ViewStatus NextChunkView(const char** p, size_t* n) {
+    if (mmap_failed_) return kUnavailable;
+    if (cur_ >= end_) return kEnd;
+    int i = FileIndexOf(cur_);
+    const char* base = MapFile(i);
+    if (!base) return kUnavailable;
+    int64_t avail_end = std::min(prefix_[i + 1], end_);
+    int64_t off = cur_ - prefix_[i];
+    int64_t limit = avail_end - prefix_[i];
+    int64_t target = std::min<int64_t>(off + chunk_bytes_, limit);
+    int64_t cut = (target < limit)
+                      ? CutViewChunk(base, off, target, limit)
+                      : limit;
+    *p = base + off;
+    *n = (size_t)(cut - off);
+    bytes_read_ += (int64_t)*n;
+    cur_ = prefix_[i] + cut;
+    return kView;
+  }
 
   // Next buffer of whole records; false at end of shard. Builds into
   // *out in place so a pooled buffer keeps its capacity across chunks
@@ -561,6 +599,11 @@ class ShardReaderBase {
   virtual int64_t SeekRecordBegin(FILE* f) = 0;
   // length of the longest whole-record prefix of buf (0 = none complete)
   virtual size_t FindLastRecordEnd(const std::string& buf) = 0;
+  // view-mode cut: largest record-boundary position in (off, limit]
+  // near target (off < cut <= limit); default extends past target when
+  // a single record exceeds the window
+  virtual int64_t CutViewChunk(const char* base, int64_t off,
+                               int64_t target, int64_t limit) = 0;
 
  protected:
   void CloseFile() {
@@ -661,69 +704,30 @@ class TextShardReader : public ShardReaderBase {
   TextShardReader(std::vector<FileEntry> files, int64_t part, int64_t nparts,
                   int64_t chunk_bytes)
       : ShardReaderBase(std::move(files), chunk_bytes, /*align=*/1) {
-    const char* no_mmap = getenv("DMLC_TPU_NO_MMAP");
-    if (no_mmap && no_mmap[0] == '1') mmap_failed_ = true;
     InitPartition(part, nparts);
   }
 
-  // Zero-copy chunk: *p/*n view the mmap'd file directly, cut at a TEXT
-  // record boundary (this method lives on TextShardReader because the
-  // cut rule is the newline rule — RecordIO's in-place stitch also
-  // MUTATES its chunks and must never see a read-only view). Views stay
-  // valid until the reader is destroyed. kUnavailable when the current
-  // file cannot be safely mapped (or DMLC_TPU_NO_MMAP=1): the caller
-  // switches to buffered NextChunk, which resumes from the same shared
-  // cursor — view chunks always end on a record boundary.
-  //
-  // Residual risk, stated honestly: the fstat size check catches files
-  // that shrank BEFORE mapping (that path stays a clean EngineError via
-  // the buffered fallback), but a file truncated by another process
-  // AFTER mapping makes later page touches SIGBUS — inherent to mmap
-  // (every mapped-IO reader shares it). Set DMLC_TPU_NO_MMAP=1 for
-  // environments where inputs mutate mid-run.
-  ViewStatus NextChunkView(const char** p, size_t* n) {
-    if (mmap_failed_) return kUnavailable;
-    if (cur_ >= end_) return kEnd;
-    int i = FileIndexOf(cur_);
-    const char* base = MapFile(i);
-    if (!base) return kUnavailable;
-    int64_t avail_end = std::min(prefix_[i + 1], end_);
-    int64_t off = cur_ - prefix_[i];
-    int64_t limit = avail_end - prefix_[i];
-    int64_t target = std::min<int64_t>(off + chunk_bytes_, limit);
-    int64_t cut = limit;
-    if (target < limit) {
-      // cut after the last newline in [off, target); a '\r' can only
-      // beat the last '\n' if it sits after it, so scan the tail only
-      // (avoids a full extra backward pass on LF-only data); if a
-      // record is longer than a chunk, extend forward to the next
-      // newline byte
-      const char* nl = (const char*)memrchr(base + off, '\n',
-                                            (size_t)(target - off));
-      const char* tail = nl ? nl + 1 : base + off;
-      const char* cr = (const char*)memrchr(
-          tail, '\r', (size_t)(base + target - tail));
-      const char* best = cr ? cr : nl;
-      if (best) {
-        cut = (best - base) + 1;
-      } else {
-        const void* fwd =
-            memchr(base + target, '\n', (size_t)(limit - target));
-        const void* fwr =
-            memchr(base + target, '\r', (size_t)(limit - target));
-        const char* first = (const char*)(
-            fwd && fwr ? std::min(fwd, fwr) : (fwd ? fwd : fwr));
-        cut = first ? (first - base) + 1 : limit;
-      }
-    }
-    *p = base + off;
-    *n = (size_t)(cut - off);
-    bytes_read_ += (int64_t)*n;
-    cur_ = prefix_[i] + cut;
-    return kView;
+ protected:
+  // view cut: after the last newline in [off, target); a '\r' can only
+  // beat the last '\n' if it sits after it, so scan the tail only
+  // (avoids a full extra backward pass on LF-only data); if a record
+  // is longer than the window, extend forward to the next newline byte
+  int64_t CutViewChunk(const char* base, int64_t off, int64_t target,
+                       int64_t limit) override {
+    const char* nl = (const char*)memrchr(base + off, '\n',
+                                          (size_t)(target - off));
+    const char* tail = nl ? nl + 1 : base + off;
+    const char* cr = (const char*)memrchr(
+        tail, '\r', (size_t)(base + target - tail));
+    const char* best = cr ? cr : nl;
+    if (best) return (best - base) + 1;
+    const void* fwd = memchr(base + target, '\n', (size_t)(limit - target));
+    const void* fwr = memchr(base + target, '\r', (size_t)(limit - target));
+    const char* first = (const char*)(
+        fwd && fwr ? std::min(fwd, fwr) : (fwd ? fwd : fwr));
+    return first ? (first - base) + 1 : limit;
   }
 
- protected:
   // skip through the next newline run (reference: LineSplitter)
   int64_t SeekRecordBegin(FILE* f) override {
     int64_t skipped = 0;
@@ -800,14 +804,16 @@ class RecordIOShardReader : public ShardReaderBase {
     }
   }
 
-  // walk whole frames; a record completes at a cflag 0 or 3 frame
-  size_t FindLastRecordEnd(const std::string& buf) override {
-    size_t pos = 0, complete_end = 0, n = buf.size();
+  // walk whole frames in [b, b+n); returns the end of the last complete
+  // record (0 = none), stopping early once one ends at/after stop_at —
+  // shared by the buffered cut and the view cut
+  static size_t WalkFrames(const char* b, size_t n, size_t stop_at) {
+    size_t pos = 0, complete_end = 0;
     bool in_multi = false;
     while (pos + 8 <= n) {
-      if (load_u32le(buf.data() + pos) != kRecIOMagic)
+      if (load_u32le(b + pos) != kRecIOMagic)
         throw EngineError{"recordio: lost frame alignment in shard read"};
-      uint32_t lrec = load_u32le(buf.data() + pos + 4);
+      uint32_t lrec = load_u32le(b + pos + 4);
       uint32_t cflag = (lrec >> 29) & 7;
       size_t clen = lrec & ((1u << 29) - 1);
       size_t frame_end = pos + 8 + clen + ((4 - (clen & 3)) & 3);
@@ -824,8 +830,23 @@ class RecordIOShardReader : public ShardReaderBase {
         in_multi = false;
       }
       pos = frame_end;
+      if (complete_end && complete_end >= stop_at) break;
     }
     return complete_end;
+  }
+
+  size_t FindLastRecordEnd(const std::string& buf) override {
+    return WalkFrames(buf.data(), buf.size(), buf.size() + 1);
+  }
+
+  // view cut: last complete record end near target (extending to limit
+  // when a record exceeds the window; limit itself when nothing
+  // completes — the decode then reports the truncation)
+  int64_t CutViewChunk(const char* base, int64_t off, int64_t target,
+                       int64_t limit) override {
+    size_t w = WalkFrames(base + off, (size_t)(limit - off),
+                          (size_t)(target - off));
+    return w ? off + (int64_t)w : limit;
   }
 };
 
@@ -838,15 +859,54 @@ class RecordIOShardReader : public ShardReaderBase {
 // touches only frame headers + the rare multi-frame payloads. Zero-copy
 // at the ABI with the same lease semantics as parser blocks.
 struct RecBatch {
-  std::string data;           // the chunk, multi-frame records compacted
-  Buf<int64_t> starts, ends;  // per-record [start, end) into data
+  std::string data;            // owned chunk (multi-frame compacted), or
+  const char* vbase = nullptr; // read-only mmap view (single-frame only)
+  size_t vlen = 0;
+  Buf<int64_t> starts, ends;   // per-record [start, end) into bytes()
+
+  const char* bytes() const { return vbase ? vbase : data.data(); }
 
   void clear() {
     data.clear();
+    vbase = nullptr;
+    vlen = 0;
     starts.clear();
     ends.clear();
   }
 };
+
+// Decode a READ-ONLY chunk view: fills starts/ends iff every record is
+// single-frame (then records are pure views — nothing to stitch, the
+// mapped pages stay clean, epochs can re-walk them). Returns false at
+// the first continuation frame; the caller copies the span and runs the
+// mutating in-place decode instead. Multi-frame (escaped-magic) records
+// are rare in real data, so the copy path is the exception.
+bool DecodeRecordIOViews(const char* d, size_t n, RecBatch* out) {
+  size_t pos = 0;
+  out->starts.reserve(n / 64 + 1);
+  out->ends.reserve(n / 64 + 1);
+  while (pos < n) {
+    if (pos + 8 > n)
+      throw EngineError{"recordio: truncated frame header"};
+    if (load_u32le(d + pos) != kRecIOMagic)
+      throw EngineError{"recordio: invalid magic"};
+    uint32_t lrec = load_u32le(d + pos + 4);
+    uint32_t cflag = (lrec >> 29) & 7;
+    size_t clen = lrec & ((1u << 29) - 1);
+    size_t start = pos + 8;
+    if (start + clen > n)
+      throw EngineError{"recordio: truncated payload"};
+    if (cflag != 0) {  // multi-frame: needs the mutating stitch
+      out->starts.clear();
+      out->ends.clear();
+      return false;
+    }
+    out->starts.push_back((int64_t)start);
+    out->ends.push_back((int64_t)(start + clen));
+    pos = start + clen + ((4 - (clen & 3)) & 3);
+  }
+  return true;
+}
 
 // decode a chunk of whole frames, stitching multi-frame records in
 // place (reference: RecordIOChunkReader::NextRecord — escaped magics
@@ -1653,17 +1713,29 @@ struct RecordIOHandle {
     chunks = std::make_unique<BoundedQueue<ChunkItem>>(4);
     reader_thread = std::make_unique<std::thread>([this] {
       try {
+        bool try_views = true;  // mmap fast path until a file declines
         while (true) {
           ChunkItem item;
-          {
-            std::lock_guard<std::mutex> lk(pool_mu);
-            if (!chunk_pool.empty()) {
-              item.data = std::move(chunk_pool.back());
-              chunk_pool.pop_back();
-            }
-          }
           int64_t t0 = now_ns();
-          bool more = reader->NextChunk(&item.data);
+          bool more;
+          if (try_views) {
+            auto st = reader->NextChunkView(&item.view, &item.view_len);
+            if (st == ShardReaderBase::kUnavailable) {
+              try_views = false;  // buffered resumes at same cursor
+              stats.reader_busy_ns += now_ns() - t0;
+              continue;
+            }
+            more = (st == ShardReaderBase::kView);
+          } else {
+            {
+              std::lock_guard<std::mutex> lk(pool_mu);
+              if (!chunk_pool.empty()) {
+                item.data = std::move(chunk_pool.back());
+                chunk_pool.pop_back();
+              }
+            }
+            more = reader->NextChunk(&item.data);
+          }
           stats.reader_busy_ns += now_ns() - t0;
           if (!more) break;
           stats.chunks += 1;
@@ -1695,10 +1767,29 @@ struct RecordIOHandle {
         }
       }
       if (!batch) batch = std::make_unique<RecBatch>();
-      batch->data = std::move(item.data);  // chunk IS the payload store
       int64_t t0 = now_ns();
       try {
-        DecodeRecordIOChunkInPlace(batch.get());
+        if (item.view &&
+            DecodeRecordIOViews(item.view, item.view_len, batch.get())) {
+          batch->vbase = item.view;  // pure views, no bytes touched
+          batch->vlen = item.view_len;
+        } else {
+          if (item.view) {
+            // multi-frame records: copy into a POOLED buffer (its
+            // capacity survives Release round-trips), then stitch
+            {
+              std::lock_guard<std::mutex> lk(pool_mu);
+              if (!chunk_pool.empty()) {
+                batch->data = std::move(chunk_pool.back());
+                chunk_pool.pop_back();
+              }
+            }
+            batch->data.assign(item.view, item.view_len);
+          } else {
+            batch->data = std::move(item.data);
+          }
+          DecodeRecordIOChunkInPlace(batch.get());
+        }
       } catch (const EngineError& err) {
         error = err.msg;
         stats.end_ns = now_ns();
@@ -1730,8 +1821,9 @@ struct RecordIOHandle {
     std::lock_guard<std::mutex> lk(pool_mu);
     auto it = outstanding.find(b);
     if (it == outstanding.end()) return;
-    // hand the chunk buffer's capacity back to the reader
-    if (chunk_pool.size() < 6)
+    // hand an owned chunk buffer's capacity back to the reader (view
+    // batches own no bytes — the mapping belongs to the reader)
+    if (!it->second->vbase && chunk_pool.size() < 6)
       chunk_pool.push_back(std::move(it->second->data));
     it->second->clear();
     batch_pool.push_back(std::move(it->second));
@@ -1922,7 +2014,7 @@ int64_t dtp_recio_next_batch(void* handle, void** block_out,
   if (nrec == 0) return 0;
   RecBatch* b = h->last;
   *block_out = b;
-  *payload = reinterpret_cast<const uint8_t*>(b->data.data());
+  *payload = reinterpret_cast<const uint8_t*>(b->bytes());
   *starts = b->starts.data();
   *ends = b->ends.data();
   return nrec;

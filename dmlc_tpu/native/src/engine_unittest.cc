@@ -224,22 +224,33 @@ static void test_shard_coverage() {
 
 // mmap view mode must yield the same byte stream as buffered mode for
 // every (part, chunk size) — chunks may be cut differently, but the
-// concatenation per shard is identical
+// concatenation per shard is identical. Files are > the 64KB minimum
+// chunk so the view cut rule and mid-file boundaries genuinely run.
 static void test_view_buffered_parity() {
-  std::string dir = "/tmp/dtp_engine_unittest";  // reuse shard fixture
+  std::string dir = "/tmp/dtp_engine_unittest_view";
+  std::system(("rm -rf " + dir + " && mkdir -p " + dir).c_str());
+  srand(21);
   std::vector<FileEntry> files;
   for (int f = 0; f < 2; ++f) {
     std::string path = dir + "/part" + std::to_string(f) + ".libsvm";
+    std::ofstream out(path);
+    for (int i = 0; i < 6000; ++i) {  // ~200KB per file
+      out << (i % 2) << " " << i << ":1.5";
+      for (int j = rand() % 5; j > 0; --j)
+        out << " " << 1000 + j << ":0.25";
+      out << ((i % 37 == 0) ? "\r\n" : "\n");  // CRLF mixed in
+    }
+    out.close();
     std::ifstream sz(path, std::ios::ate | std::ios::binary);
-    CHECK_TRUE(sz.good());
     files.push_back({path, (int64_t)sz.tellg()});
   }
   for (int nparts : {1, 3}) {
-    for (int64_t chunk : {1, 1 << 20}) {
+    for (int64_t chunk : {64 * 1024, 1 << 20}) {
       for (int part = 0; part < nparts; ++part) {
         TextShardReader buffered(files, part, nparts, chunk);
         TextShardReader viewed(files, part, nparts, chunk);
         std::string a, b, buf;
+        int view_chunks = 0;
         while (buffered.NextChunk(&buf)) a += buf;
         const char* p;
         size_t n;
@@ -248,9 +259,12 @@ static void test_view_buffered_parity() {
           CHECK_TRUE(st != ShardReaderBase::kUnavailable);
           if (st != ShardReaderBase::kView) break;
           b.append(p, n);
+          ++view_chunks;
         }
         CHECK_TRUE(a == b);
         CHECK_EQ_(buffered.bytes_read(), viewed.bytes_read());
+        if (nparts == 1 && chunk == 64 * 1024)
+          CHECK_TRUE(view_chunks >= 5);  // cut rule genuinely exercised
       }
     }
   }
@@ -305,33 +319,57 @@ static void test_recordio_shard_coverage() {
     std::ifstream sz(path, std::ios::ate | std::ios::binary);
     files.push_back({path, (int64_t)sz.tellg()});
   }
-  for (int nparts : {1, 2, 5}) {
-    for (int64_t chunk : {1, 1 << 20}) {
-      std::multiset<uint64_t> seen;
-      for (int part = 0; part < nparts; ++part) {
-        RecordIOShardReader r(files, part, nparts, chunk);
-        std::string buf;
-        while (r.NextChunk(&buf)) {
-          RecBatch b;
-          b.data = std::move(buf);
-          DecodeRecordIOChunkInPlace(&b);
-          for (size_t k = 0; k < b.starts.size(); ++k) {
-            uint64_t tag;
-            CHECK_TRUE(b.ends.data()[k] - b.starts.data()[k] >= 8);
-            std::memcpy(&tag, b.data.data() + b.starts.data()[k], 8);
-            // stitched payload must match what was written
-            std::string got(b.data.data() + b.starts.data()[k],
-                            (size_t)(b.ends.data()[k] - b.starts.data()[k]));
-            CHECK_TRUE(tag < all_records.size());
-            CHECK_TRUE(got == all_records[(size_t)tag]);
-            seen.insert(tag);
+  for (int use_views : {0, 1}) {  // buffered AND mmap view paths
+    for (int nparts : {1, 2, 5}) {
+      for (int64_t chunk : {1, 1 << 20}) {
+        std::multiset<uint64_t> seen;
+        for (int part = 0; part < nparts; ++part) {
+          RecordIOShardReader r(files, part, nparts, chunk);
+          auto consume = [&](const char* data, RecBatch& b) {
+            for (size_t k = 0; k < b.starts.size(); ++k) {
+              uint64_t tag;
+              CHECK_TRUE(b.ends.data()[k] - b.starts.data()[k] >= 8);
+              std::memcpy(&tag, data + b.starts.data()[k], 8);
+              // (stitched) payload must match what was written
+              std::string got(
+                  data + b.starts.data()[k],
+                  (size_t)(b.ends.data()[k] - b.starts.data()[k]));
+              CHECK_TRUE(tag < all_records.size());
+              CHECK_TRUE(got == all_records[(size_t)tag]);
+              seen.insert(tag);
+            }
+          };
+          if (use_views) {
+            const char* p;
+            size_t n;
+            while (true) {
+              auto st = r.NextChunkView(&p, &n);
+              CHECK_TRUE(st != ShardReaderBase::kUnavailable);
+              if (st != ShardReaderBase::kView) break;
+              RecBatch b;
+              if (DecodeRecordIOViews(p, n, &b)) {
+                consume(p, b);  // pure views (no multi-frame records)
+              } else {
+                b.data.assign(p, n);  // escaped-magic fallback: stitch
+                DecodeRecordIOChunkInPlace(&b);
+                consume(b.data.data(), b);
+              }
+            }
+          } else {
+            std::string buf;
+            while (r.NextChunk(&buf)) {
+              RecBatch b;
+              b.data = std::move(buf);
+              DecodeRecordIOChunkInPlace(&b);
+              consume(b.data.data(), b);
+              buf = std::move(b.data);
+            }
           }
-          buf = std::move(b.data);
         }
+        CHECK_EQ_(seen.size(), all_records.size());
+        CHECK_TRUE(std::set<uint64_t>(seen.begin(), seen.end()).size() ==
+                   seen.size());
       }
-      CHECK_EQ_(seen.size(), all_records.size());
-      CHECK_TRUE(std::set<uint64_t>(seen.begin(), seen.end()).size() ==
-                 seen.size());
     }
   }
 }
