@@ -192,6 +192,26 @@ inline uint64_t parse_digits_k(uint64_t w, int k) {
                 (0x3030303030303030ULL >> (k * 8)));
 }
 
+// branchless variant for k in 0..8 (k==0 -> 0): token-length-driven
+// parsing produces unpredictable k, and a data-dependent branch here
+// costs a mispredict per token. Table lookups replace both the k==8
+// branch and the k==0 shift-by-64 hazard.
+const uint64_t kDigitFill[9] = {
+    0x3030303030303030ULL, 0x0030303030303030ULL, 0x0000303030303030ULL,
+    0x0000003030303030ULL, 0x0000000030303030ULL, 0x0000000000303030ULL,
+    0x0000000000003030ULL, 0x0000000000000030ULL, 0x0000000000000000ULL};
+
+inline uint64_t parse_digits_k_bl(uint64_t w, int k) {
+  // k==0 must yield 0: the masked shift leaves w intact there, so a
+  // branchless keep-mask (all-ones iff k != 0) discards it instead
+  uint64_t keep = (uint64_t)0 - (uint64_t)(k != 0);
+  return parse8(((w << (((8 - k) * 8) & 63)) & keep) | kDigitFill[k]);
+}
+
+inline bool is_ws(char c) { return c == ' ' || c == '\t'; }
+inline bool is_nl(char c) { return c == '\n' || c == '\r'; }
+
+
 // Fused scan+parse: consume a decimal starting at b without knowing the
 // token end, stopping at the first byte that cannot continue it. Returns
 // the end of the consumed prefix on fast-path success (value correctly
@@ -302,9 +322,6 @@ inline bool parse_i64(const char* b, const char* e, int64_t* out) {
   return r.ec == std::errc() && r.ptr == e;
 }
 
-inline bool is_ws(char c) { return c == ' ' || c == '\t'; }
-inline bool is_nl(char c) { return c == '\n' || c == '\r'; }
-
 // ---------------------------------------------------------------- CSR arena
 
 // Growable POD buffer without std::vector's per-push capacity check cost
@@ -344,16 +361,28 @@ struct Buf {
   const T* data() const { return d.get(); }
   T* begin() { return d.get(); }
   T* end() { return d.get() + n; }
+  const T* begin() const { return d.get(); }
+  const T* end() const { return d.get() + n; }
+  T& back() { return d[n - 1]; }
+  T& operator[](size_t i) { return d[i]; }
+  const T& operator[](size_t i) const { return d[i]; }
   size_t size() const { return n; }
   bool empty() const { return n == 0; }
   void clear() { n = 0; }
 };
 
 struct CSRArena {
-  std::vector<int64_t> offset{0};
-  std::vector<float> label;
+  // offset/label are raw-cursor hot in every slice parser (one write per
+  // row); weight/qid are DEFERRED — libsvm/libfm rows are all-default
+  // (weight 1.0, qid -1) in the overwhelmingly common case and the ABI
+  // already reports has_weight/has_qid, so the vectors stay empty until
+  // a row actually carries the field (then earlier rows are backfilled)
+  Buf<int64_t> offset;
+  Buf<float> label;
   std::vector<float> weight;
   std::vector<int64_t> qid;
+
+  CSRArena() { offset.push_back(0); }
   // indices are parsed straight into u32 (the RowBlock default dtype, and
   // zero-copy at the ABI); the first >u32 index widens the block to u64
   Buf<uint32_t> index32;
@@ -423,28 +452,6 @@ struct CSRArena {
     }
   }
 
-  void append(CSRArena&& o) {
-    int64_t base = offset.back();
-    offset.reserve(offset.size() + o.rows());
-    for (size_t i = 1; i < o.offset.size(); ++i)
-      offset.push_back(base + o.offset[i]);
-    auto cat = [](auto& dst, auto& src) {
-      dst.insert(dst.end(), src.begin(), src.end());
-    };
-    if (o.wide) widen();
-    if (wide) {
-      o.widen();
-      cat(index64, o.index64);
-    } else {
-      index32.append(o.index32);
-    }
-    cat(label, o.label); cat(weight, o.weight); cat(qid, o.qid);
-    value.append(o.value);
-    cat(field, o.field);
-    has_weight |= o.has_weight; has_qid |= o.has_qid; has_field |= o.has_field;
-    min_index = std::min(min_index, o.min_index);
-    max_index = std::max(max_index, o.max_index);
-  }
 };
 
 // ------------------------------------------------------------- file shard
@@ -978,24 +985,23 @@ struct ParserConfig {
 
 // parse [b, e) of whole text records into arena; throws EngineError
 void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
-  // per-row vectors: density heuristic (cheap, checked pushes)
   size_t bytes = (size_t)(e - b);
-  a->label.reserve(bytes / 64);
-  a->weight.reserve(bytes / 64);
-  a->qid.reserve(bytes / 64);
-  a->offset.reserve(bytes / 64 + 1);
-  // hot per-feature buffers: worst-case bound ("i:v " is ≥4 bytes per
-  // feature) reserved once so the loop can write through raw cursors
-  // with no per-push capacity check; untouched tail pages never fault
+  // worst-case bounds reserved once → raw unchecked cursor writes on the
+  // whole hot path (untouched tail pages never fault): a feature token
+  // is ≥4 bytes incl. separator ("i:v "), a row ≥2 bytes incl. newline
   a->index32.reserve(a->index32.size() + bytes / 4 + 1);
   a->value.reserve(a->value.size() + bytes / 4 + 1);
+  a->label.reserve(a->label.size() + bytes / 2 + 2);
+  a->offset.reserve(a->offset.size() + bytes / 2 + 2);
   uint32_t* ic = a->index32.data() + a->index32.size();
   float* vc = a->value.data() + a->value.size();
+  float* lc = a->label.data() + a->label.size();
+  int64_t* oc = a->offset.data() + a->offset.size();
+  int64_t off = oc[-1];  // arena invariant: offset always starts {0}
   // Single pass, no line-end pre-scan: rows are delimited by the token
-  // loop itself hitting a newline (the old find-line-end-first structure
-  // cost a full extra pass over every byte). Row-per-line semantics are
-  // preserved because every token scan stops at '\n'/'\r' and the next
-  // row starts with a fresh label parse.
+  // loop itself hitting a newline. Row-per-line semantics are preserved
+  // because every token scan stops at '\n'/'\r' and the next row starts
+  // with a fresh label parse.
   const char* p = b;
   while (p < e) {
     // skip newlines and leading whitespace (blank/ws-only lines fold in)
@@ -1030,21 +1036,34 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
       const char* s = q;
       if (*s == '+') ++s;  // golden contract allows '+'
       const char* dstart = s;
-      uint64_t idx = 0;
-      while (s < e) {  // SWAR bulk: first ≤19 digits can't overflow
-        uint64_t w = load8(s, e);
-        int k = digit_run_len(w);
-        if (k == 0 || (s - dstart) + k > 19) break;
-        idx = idx * kPow10U64[k] + parse_digits_k(w, k);
+      uint64_t w = load8(s, e);
+      int k = digit_run_len(w);
+      uint64_t idx;
+      if (k < 8) {
+        // the whole index sits inside one 8-byte load (the byte at s+k
+        // is a non-digit, so the run IS the index)
+        idx = parse_digits_k_bl(w, k);
         s += k;
-        if (k < 8) break;
-      }
-      while (s < e) {  // tail with exact overflow semantics
-        unsigned d = (unsigned)(*s - '0');
-        if (d > 9) break;
-        if (idx > (UINT64_MAX - d) / 10) { s = dstart; break; }  // overflow
-        idx = idx * 10 + d;
-        ++s;
+      } else {
+        // ≥8-digit index: seed with the 8 digits already classified,
+        // then bulk loop + tail with exact overflow semantics
+        idx = parse8(w);
+        s += 8;
+        while (s < e) {  // SWAR bulk: first ≤19 digits can't overflow
+          w = load8(s, e);
+          int kk = digit_run_len(w);
+          if (kk == 0 || (s - dstart) + kk > 19) break;
+          idx = idx * kPow10U64[kk] + parse_digits_k(w, kk);
+          s += kk;
+          if (kk < 8) break;
+        }
+        while (s < e) {  // tail with exact overflow semantics
+          unsigned d = (unsigned)(*s - '0');
+          if (d > 9) break;
+          if (idx > (UINT64_MAX - d) / 10) { s = dstart; break; }  // overflow
+          idx = idx * 10 + d;
+          ++s;
+        }
       }
       if (s == dstart || s >= e || *s != ':') {
         // not "digits:..." — qid token (only directly after the label,
@@ -1057,7 +1076,11 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
           if (!parse_i64(q + 4, tok_end, &qid))
             throw EngineError{"libsvm: bad qid token '" +
                               std::string(q, tok_end) + "'"};
-          a->has_qid = true;
+          if (!a->has_qid) {
+            // first qid in this arena: backfill -1 for completed rows
+            a->has_qid = true;
+            a->qid.assign((size_t)(lc - a->label.data()), -1);
+          }
           q = tok_end;
           continue;
         }
@@ -1098,11 +1121,15 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
       q = s;
     }
     p = q;
-    a->label.push_back(label);
-    a->weight.push_back(1.0f);
-    a->qid.push_back(qid);
-    a->offset.push_back(a->offset.back() + (int64_t)row_nnz);
+    DTP_DCHECK(lc < a->label.data() + a->label.cap);
+    DTP_DCHECK(oc < a->offset.data() + a->offset.cap);
+    *lc++ = label;
+    off += (int64_t)row_nnz;
+    *oc++ = off;
+    if (a->has_qid) a->qid.push_back(qid);
   }
+  a->label.n = (size_t)(lc - a->label.data());
+  a->offset.n = (size_t)(oc - a->offset.data());
   if (!a->wide) a->index32.n = (size_t)(ic - a->index32.data());
   a->value.n = (size_t)(vc - a->value.data());
 }
@@ -1117,12 +1144,19 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
                          is_nl(d));
   // hot per-cell buffers: worst-case bound (a feature cell is >=2 bytes
   // incl. delimiter, "0,") reserved once so the loop writes through raw
-  // cursors with no per-push capacity check (same pattern as libsvm)
+  // cursors with no per-push capacity check (same pattern as libsvm);
+  // a row is ≥2 bytes incl. newline
   size_t bytes = (size_t)(e - b);
   a->index32.reserve(a->index32.size() + bytes / 2 + 1);
   a->value.reserve(a->value.size() + bytes / 2 + 1);
+  a->label.reserve(a->label.size() + bytes / 2 + 2);
+  a->offset.reserve(a->offset.size() + bytes / 2 + 2);
   uint32_t* ic = a->index32.data() + a->index32.size();
   float* vc = a->value.data() + a->value.size();
+  float* lc = a->label.data() + a->label.size();
+  int64_t* oc = a->offset.data() + a->offset.size();
+  int64_t off = oc[-1];  // arena invariant: offset always starts {0}
+  const bool want_weight = cfg.weight_column >= 0;
   // single pass, no line-end pre-scan (same structure as libsvm above)
   const char* p = b;
   while (p < e) {
@@ -1198,16 +1232,22 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
       throw EngineError{"csv: non-uniform number of columns (" +
                         std::to_string(col) + " vs " + std::to_string(expect) +
                         ")"};
-    if (cfg.weight_column >= 0) a->has_weight = true;
     if (row_nnz) {
       a->min_index = 0;
       a->max_index = std::max(a->max_index, (uint64_t)(fidx - 1));
     }
-    a->label.push_back(label);
-    a->weight.push_back(weight);
-    a->qid.push_back(-1);
-    a->offset.push_back(a->offset.back() + (int64_t)row_nnz);
+    DTP_DCHECK(lc < a->label.data() + a->label.cap);
+    DTP_DCHECK(oc < a->offset.data() + a->offset.cap);
+    *lc++ = label;
+    off += (int64_t)row_nnz;
+    *oc++ = off;
+    if (want_weight) {
+      a->has_weight = true;
+      a->weight.push_back(weight);
+    }
   }
+  a->label.n = (size_t)(lc - a->label.data());
+  a->offset.n = (size_t)(oc - a->offset.data());
   a->index32.n = (size_t)(ic - a->index32.data());  // csv never widens
   a->value.n = (size_t)(vc - a->value.data());
 }
@@ -1259,8 +1299,6 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
     p = q;
     a->has_field = true;
     a->label.push_back(label);
-    a->weight.push_back(1.0f);
-    a->qid.push_back(-1);
     a->offset.push_back(a->offset.back() + (int64_t)row_nnz);
   }
 }

@@ -1,0 +1,161 @@
+// Parse-kernel microbenchmark: times ParseLibSVMSlice / ParseCSVSlice on
+// synthetic buffers shaped like the BASELINE configs (a1a short rows,
+// criteo long rows, HIGGS csv), independent of the pipeline. Used to
+// iterate on the single-core kernel (VERDICT r2 #1); not run in CI.
+//
+// Build: g++ -O3 -march=native -std=c++17 engine_microbench.cc -o mb
+// Run:   ./mb [iters]
+
+#include "engine.cc"
+
+#include <cstdio>
+#include <random>
+#include <string>
+
+static std::string make_a1a(size_t target) {
+  std::mt19937 rng(0);
+  std::string s;
+  s.reserve(target + 256);
+  std::uniform_int_distribution<int> nnz(8, 18), idx(0, 122);
+  int i = 0;
+  while (s.size() < target) {
+    s += (i++ % 2) ? "1" : "-1";
+    int n = nnz(rng);
+    int last = -1;
+    for (int k = 0; k < n; ++k) {
+      int j = idx(rng);
+      if (j <= last) j = last + 1;
+      last = j;
+      s += ' ';
+      s += std::to_string(j);
+      s += ":1";
+    }
+    s += '\n';
+  }
+  return s;
+}
+
+static std::string make_criteo(size_t target) {
+  std::mt19937 rng(1);
+  std::string s;
+  s.reserve(target + 1024);
+  std::uniform_int_distribution<int> nnz(25, 45);
+  std::uniform_int_distribution<int> idx(0, 999999);
+  std::uniform_real_distribution<double> val(0.0, 1.0);
+  char buf[64];
+  int i = 0;
+  while (s.size() < target) {
+    s += (i++ % 2) ? "1" : "0";
+    int n = nnz(rng);
+    for (int k = 0; k < n; ++k) {
+      std::snprintf(buf, sizeof buf, " %d:%.6f", idx(rng), val(rng));
+      s += buf;
+    }
+    s += '\n';
+  }
+  return s;
+}
+
+static std::string make_csv(size_t target) {
+  std::mt19937 rng(2);
+  std::uniform_real_distribution<double> val(0.0, 1.0);
+  std::string s;
+  s.reserve(target + 1024);
+  char buf[64];
+  int i = 0;
+  while (s.size() < target) {
+    s += (i++ % 2) ? "1" : "0";
+    for (int k = 0; k < 28; ++k) {
+      std::snprintf(buf, sizeof buf, ",%.6f", val(rng));
+      s += buf;
+    }
+    s += '\n';
+  }
+  return s;
+}
+
+// fold the arena into a checksum so the work can't be optimized out and
+// variants can be compared for identical output
+static uint64_t digest(const CSRArena& a) {
+  uint64_t h = 1469598103934665603ULL;
+  auto mix = [&h](uint64_t v) {
+    h ^= v;
+    h *= 1099511628211ULL;
+  };
+  for (size_t i = 0; i < a.label.size(); ++i) {
+    uint32_t lb;
+    std::memcpy(&lb, a.label.data() + i, 4);
+    mix(lb);
+  }
+  for (int64_t o : a.offset) mix((uint64_t)o);
+  if (a.wide)
+    for (uint64_t ix : a.index64) mix(ix);
+  else
+    for (size_t i = 0; i < a.index32.size(); ++i) mix(a.index32.data()[i]);
+  for (size_t i = 0; i < a.value.size(); ++i) {
+    uint32_t vb;
+    std::memcpy(&vb, a.value.data() + i, 4);
+    mix(vb);
+  }
+  // weight/qid only count when materialized — the ABI contract
+  // (has_weight/has_qid gate what Python ever sees)
+  if (a.has_weight)
+    for (size_t i = 0; i < a.weight.size(); ++i) {
+      uint32_t wb;
+      std::memcpy(&wb, &a.weight[i], 4);
+      mix(wb);
+    }
+  if (a.has_qid)
+    for (int64_t q : a.qid) mix((uint64_t)q);
+  mix(a.min_index);
+  mix(a.max_index + 7);
+  mix(a.has_weight ? 2 : 3);
+  mix(a.has_qid ? 5 : 7);
+  return h;
+}
+
+template <typename F>
+static void run(const char* name, const std::string& data, int iters, F fn) {
+  CSRArena a;
+  // warmup + digest
+  fn(data.data(), data.data() + data.size(), &a);
+  uint64_t d0 = digest(a);
+  double best = 1e30;
+  for (int it = 0; it < iters; ++it) {
+    a.clear();
+    auto t0 = std::chrono::steady_clock::now();
+    fn(data.data(), data.data() + data.size(), &a);
+    auto t1 = std::chrono::steady_clock::now();
+    double dt = std::chrono::duration<double>(t1 - t0).count();
+    if (dt < best) best = dt;
+  }
+  std::printf("%-22s %7.3f GB/s  (rows=%zu nnz=%zu digest=%016llx)\n", name,
+              data.size() / best / 1e9, a.rows(), a.nnz(),
+              (unsigned long long)d0);
+}
+
+int main(int argc, char** argv) {
+  int iters = argc > 1 ? std::atoi(argv[1]) : 7;
+  size_t mb = 48;
+  std::string a1a = make_a1a(mb << 20);
+  std::string criteo = make_criteo(mb << 20);
+  std::string csv = make_csv(mb << 20);
+
+  run("libsvm/a1a", a1a, iters,
+      [](const char* b, const char* e, CSRArena* a) {
+        ParseLibSVMSlice(b, e, a);
+      });
+  run("libsvm/criteo", criteo, iters,
+      [](const char* b, const char* e, CSRArena* a) {
+        ParseLibSVMSlice(b, e, a);
+      });
+  ParserConfig cfg;
+  cfg.format = Format::kCSV;
+  cfg.label_column = 0;
+  run("csv/higgs", csv, iters,
+      [&cfg](const char* b, const char* e, CSRArena* a) {
+        std::atomic<long> ncol(-1);
+        ParseCSVSlice(b, e, cfg, &ncol, a);
+      });
+  return 0;
+}
