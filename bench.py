@@ -6,9 +6,12 @@ the multi-part/multi-host shard shape is bench_suite config 4, which
 runs all parts with concurrent pipelines) → native C++ parse → zero-copy
 CSR views → async jax.device_put into device memory, transfers riding
 under parse via detached leases. Prints exactly ONE JSON line:
-{"metric", "value", "unit", "vs_baseline"} — vs_baseline is value / 2.0
-(the BASELINE.json target of 2 GB/s/chip; the reference publishes no
-numbers of its own, see BASELINE.md).
+{"metric", "value", "unit", "vs_baseline", "best_epoch", "epochs"} —
+"value" is the SUSTAINED rate (20%-trimmed mean of per-epoch GB/s over
+>= 5 epochs / >= the time budget), "best_epoch" the fastest single
+epoch, and vs_baseline is value / 2.0 (the BASELINE.json target of
+2 GB/s/chip; the reference publishes no numbers of its own, see
+BASELINE.md).
 
 Secondary diagnostics go to stderr.
 """
@@ -122,11 +125,13 @@ def main() -> None:
         stats = parser.stats() if hasattr(parser, "stats") else None
         return time.perf_counter() - t0, t_pull, rows, nnz, stats
 
-    # repeated epochs, keep the best: this host's CPU is burstable and
-    # varies 2-4x run-to-run; keep sampling until the best stops
-    # improving (or a time budget runs out) so the recorded number is
-    # the steady-state hardware rate, not a throttled window
+    # Sustained measurement (VERDICT r2 #2): run epochs over a fixed byte
+    # budget (>= 3x the data, >= ~1/2 the time budget) and report the
+    # TRIMMED MEAN as the headline — a number that survives a cold re-run
+    # on this burstable host — with the best epoch alongside as the
+    # hardware-capability ceiling.
     budget_s = float(os.environ.get("DMLC_TPU_BENCH_BUDGET_S", "60"))
+    min_epochs = max(3, int(os.environ.get("DMLC_TPU_BENCH_MIN_EPOCHS", "5")))
     # DMLC_TPU_TRACE=<dir>: dump a jax.profiler device timeline of one
     # epoch (utils.profiler.trace) for offline inspection
     trace_dir = os.environ.get("DMLC_TPU_TRACE")
@@ -136,48 +141,48 @@ def main() -> None:
             epoch()
         log(f"jax.profiler trace written to {trace_dir}")
 
+    times = []
     best = None
     best_stats = None
     t_start = time.perf_counter()
     i = 0
-    since_improved = 0
     while True:
         dt, t_pull, rows, nnz, stats = epoch()
+        times.append(dt)
         log(f"epoch {i}: rows={rows} nnz={nnz} wall={dt:.2f}s "
             f"pull-wait={t_pull:.2f}s -> {size / dt / 1e9:.3f} GB/s")
-        improved_enough = best is None or dt < best * 0.98
-        if best is None or dt < best:  # true minimum is what we report
+        if best is None or dt < best:
             best, best_stats = dt, stats
-        since_improved = 0 if improved_enough else since_improved + 1
         i += 1
         elapsed = time.perf_counter() - t_start
-        # keep sampling at least ~1/3 of the budget: the burstable CPU
-        # throttles in multi-second stretches, and converging inside one
-        # would lock in a slow window
-        if i >= 3 and ((since_improved >= 3 and elapsed > budget_s / 3)
-                       or elapsed > budget_s):
+        if i >= min_epochs and elapsed > budget_s:
             break
-    dt = best
+    # 20%-per-side trimmed mean of per-epoch rates: robust to both burst
+    # windows and throttle windows of the credit scheduler
+    rates = sorted(size / t / 1e9 for t in times)
+    k = len(rates) // 5
+    trimmed = rates[k:len(rates) - k] if len(rates) > 2 * k else rates
+    sustained = sum(trimmed) / len(trimmed)
     if best_stats:
-        # per-stage breakdown (VERDICT r1 #7): where the time goes
-        rd = best_stats["reader_busy_ns"] / 1e9
-        pb = best_stats["parse_busy_ns"] / 1e9
-        log(f"stages: read={rd:.2f}s ({size / rd / 1e9:.2f} GB/s) "
-            f"parse={pb:.2f}s ({size / pb / 1e9:.2f} GB/s summed) "
-            f"wall={best_stats['wall_ns'] / 1e9:.2f}s "
-            f"chunks={best_stats['chunks']} "
-            f"depth(chunkq={best_stats['max_chunk_queue_depth']}, "
-            f"reorder={best_stats['max_reorder_depth']})")
+        # per-stage breakdown (VERDICT r1 #7): where the best epoch's
+        # time went (shared formatter with the bench suite)
+        from dmlc_tpu.bench_suite import format_stages
+        line = format_stages(best_stats, size)
+        if line:
+            log(line)
     if hasattr(parser, "destroy"):
         parser.destroy()
 
-    gbps = size / dt / 1e9
-    log(f"best wall={dt:.2f}s -> {gbps:.3f} GB/s")
+    best_gbps = size / best / 1e9
+    log(f"sustained (trimmed mean of {len(times)} epochs) = "
+        f"{sustained:.3f} GB/s; best epoch = {best_gbps:.3f} GB/s")
     print(json.dumps({
         "metric": "libsvm_parse_to_hbm_throughput",
-        "value": round(gbps, 4),
+        "value": round(sustained, 4),
         "unit": "GB/s/chip",
-        "vs_baseline": round(gbps / TARGET_GBPS, 4),
+        "vs_baseline": round(sustained / TARGET_GBPS, 4),
+        "best_epoch": round(best_gbps, 4),
+        "epochs": len(times),
     }))
 
 

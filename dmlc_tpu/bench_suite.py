@@ -135,20 +135,36 @@ def make_parquet(path: str, mb: int, seed: int = 0) -> int:
 
 # ---------------------------------------------------------------- configs
 
-def _stage_line(parser_or_reader, size: int) -> Optional[str]:
-    """Per-stage breakdown from the native engine stats (VERDICT r1 #7)."""
-    stats = getattr(parser_or_reader, "stats", None)
-    if stats is None:
-        return None
-    s = stats()
+def format_stages(s: Dict[str, int], size: int) -> Optional[str]:
+    """One-line per-stage breakdown from an engine stats dict (VERDICT
+    r1 #7). Shared by this suite and bench.py so new stats fields are
+    threaded through once."""
     parse_key = "parse_busy_ns" if "parse_busy_ns" in s else "decode_busy_ns"
+    cpu_key = "parse_cpu_ns" if "parse_busy_ns" in s else "decode_cpu_ns"
     rd, pb, wall = s["reader_busy_ns"], s[parse_key], s["wall_ns"]
     if not (rd and pb and wall):
         return None
     stage = parse_key.split("_")[0]
+    pc = s.get(cpu_key, 0)
+    # the cpu rate is the honest per-core kernel speed: wall-based busy
+    # inflates whenever workers are preempted (1-core hosts)
+    cpu_part = (f" {stage}-cpu={pc / 1e9:.2f}s ({size / pc:.2f} GB/s/core)"
+                if pc else "")
+    extra = ""
+    if "max_chunk_queue_depth" in s:
+        extra = (f" depth(chunkq={s['max_chunk_queue_depth']}, "
+                 f"reorder={s['max_reorder_depth']})")
     return (f"stages: read={rd / 1e9:.2f}s ({size / rd:.2f} GB/s) "
-            f"{stage}={pb / 1e9:.2f}s ({size / pb:.2f} GB/s summed) "
-            f"wall={wall / 1e9:.2f}s chunks={s['chunks']}")
+            f"{stage}={pb / 1e9:.2f}s ({size / pb:.2f} GB/s summed)"
+            f"{cpu_part} wall={wall / 1e9:.2f}s chunks={s['chunks']}"
+            f"{extra}")
+
+
+def _stage_line(parser_or_reader, size: int) -> Optional[str]:
+    stats = getattr(parser_or_reader, "stats", None)
+    if stats is None:
+        return None
+    return format_stages(stats(), size)
 
 
 def bench_libsvm(mb: int) -> Dict:
@@ -161,9 +177,19 @@ def bench_libsvm(mb: int) -> Dict:
     t0 = time.perf_counter()
     p = Parser.create(path, 0, 1, format="libsvm")
     c = RowBlockContainer(np.uint32)
+    can_detach = hasattr(p, "detach")
+    leases = []
     while p.next():
-        c.push_block(p.value())
+        # hold the native leases across the drain: push_block then keeps
+        # zero-copy views and get_block's single concatenation is the one
+        # materializing copy (same copy count as the reference's C++
+        # Push(RowBlock) path)
+        c.push_block(p.value(), copy=not can_detach)
+        if can_detach:
+            leases.append(p.detach())
     block = c.get_block()
+    for lease in leases:
+        lease.release()
     rows, nnz = block.size, block.nnz
     dt = time.perf_counter() - t0
     line = _stage_line(p, size)

@@ -73,13 +73,14 @@ class RowBlock:
     """
 
     __slots__ = ("offset", "label", "weight", "qid", "field", "index",
-                 "value", "lease")
+                 "value", "lease", "max_index")
 
     def __init__(self, offset: np.ndarray, label: np.ndarray,
                  index: np.ndarray, value: Optional[np.ndarray] = None,
                  weight: Optional[np.ndarray] = None,
                  qid: Optional[np.ndarray] = None,
-                 field: Optional[np.ndarray] = None):
+                 field: Optional[np.ndarray] = None,
+                 max_index: Optional[int] = None):
         offset = np.asarray(offset, dtype=np.int64)
         check(offset.ndim == 1 and len(offset) >= 1, "offset must be 1-D, len>=1")
         size = len(offset) - 1
@@ -104,6 +105,10 @@ class RowBlock:
         if self.field is not None:
             check_eq(len(self.field), nnz, "field length mismatch")
         self.lease = None
+        # optional producer-supplied metadata: max feature index in this
+        # block (the native engine computes it during parse); None means
+        # "unknown — rescan if you need it"
+        self.max_index = max_index
 
     @property
     def size(self) -> int:
@@ -156,7 +161,8 @@ class RowBlock:
             value=self.value.copy() if self.value is not None else None,
             weight=self.weight.copy() if self.weight is not None else None,
             qid=self.qid.copy() if self.qid is not None else None,
-            field=self.field.copy() if self.field is not None else None)
+            field=self.field.copy() if self.field is not None else None,
+            max_index=self.max_index)
 
     def memory_cost_bytes(self) -> int:
         """Reference: RowBlock::MemCostBytes."""
@@ -216,8 +222,8 @@ class RowBlockContainer:
         self._s_qid: List[int] = []
         self._c_len: List[np.ndarray] = []
         self._c_label: List[np.ndarray] = []
-        self._c_weight: List[np.ndarray] = []
-        self._c_qid: List[np.ndarray] = []
+        self._c_weight: List[Optional[np.ndarray]] = []
+        self._c_qid: List[Optional[np.ndarray]] = []
         self._index: List[np.ndarray] = []
         self._value: List[Optional[np.ndarray]] = []
         self._field: List[Optional[np.ndarray]] = []
@@ -269,18 +275,25 @@ class RowBlockContainer:
         self._s_len.append(len(idx))
         self._nrows += 1
 
-    def push_block(self, block: RowBlock) -> None:
+    def push_block(self, block: RowBlock, copy: bool = True) -> None:
         """Append a whole RowBlock (reference: Push(RowBlock)).
 
         Vectorized: whole arrays become chunks (one chunk spans the whole
         block; get_block concatenates chunks, so per-row and per-block
         pushes mix freely). This is the path BasicRowIter/DiskRowIter
         drain through — no per-row Python objects are created.
+
+        ``copy=False`` skips the defensive copy of leased native-arena
+        views: the CALLER must then hold the block's lease (via
+        ``parser.detach()``) until after ``get_block()``, which
+        materializes owned arrays in its single concatenation pass. This
+        halves the drain's copy traffic (one copy total, matching the
+        reference's C++ Push which also copies exactly once).
         """
         n = block.size
         if n == 0:
             return
-        if block.lease is not None:
+        if block.lease is not None and copy:
             # ephemeral native-arena views: the container retains array
             # references, so materialize owned copies first
             block = block.copy()
@@ -288,23 +301,29 @@ class RowBlockContainer:
         off = np.asarray(block.offset, np.int64)
         self._c_len.append(off[1:] - off[:-1])
         self._c_label.append(np.asarray(block.label, np.float32))
+        # absent weight/qid stay as None placeholders (all-default rows);
+        # get_block materializes defaults only if some other chunk made
+        # the column real — the common all-default case allocates nothing
         if block.weight is not None:
             w = np.asarray(block.weight, np.float32)
             if bool(np.any(w != 1.0)):
                 self._has_weight = True
             self._c_weight.append(w)
         else:
-            self._c_weight.append(np.ones(n, np.float32))
+            self._c_weight.append(None)
         if block.qid is not None:
             q = np.asarray(block.qid, np.int64)
             if bool(np.any(q != -1)):
                 self._has_qid = True
             self._c_qid.append(q)
         else:
-            self._c_qid.append(np.full(n, -1, np.int64))
+            self._c_qid.append(None)
         idx = np.asarray(block.index, self.index_dtype)
         self._index.append(idx)
-        if len(idx):
+        if block.max_index is not None:
+            # producer-supplied (native engine computes it during parse)
+            self.max_index = max(self.max_index, int(block.max_index))
+        elif len(idx):
             self.max_index = max(self.max_index, int(idx.max()))
         if block.value is not None:
             self._has_value = True
@@ -344,10 +363,14 @@ class RowBlockContainer:
                  else np.empty(0, np.float32))
         weight = qid = None
         if self._has_weight:
-            weight = (np.concatenate(self._c_weight) if self._c_weight
+            wparts = [w if w is not None else np.ones(len(lb), np.float32)
+                      for w, lb in zip(self._c_weight, self._c_label)]
+            weight = (np.concatenate(wparts) if wparts
                       else np.empty(0, np.float32))
         if self._has_qid:
-            qid = (np.concatenate(self._c_qid) if self._c_qid
+            qparts = [q if q is not None else np.full(len(lb), -1, np.int64)
+                      for q, lb in zip(self._c_qid, self._c_label)]
+            qid = (np.concatenate(qparts) if qparts
                    else np.empty(0, np.int64))
         return RowBlock(
             offset=offset,

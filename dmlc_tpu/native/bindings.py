@@ -58,6 +58,8 @@ def load(path: str):
     ]
     lib.dtp_parser_before_first.argtypes = [C.c_void_p]
     lib.dtp_block_release.argtypes = [C.c_void_p, C.c_void_p]
+    lib.dtp_block_index_range.argtypes = [
+        C.c_void_p, C.POINTER(C.c_uint64), C.POINTER(C.c_uint64)]
     lib.dtp_parser_stats.argtypes = [C.c_void_p, C.POINTER(C.c_int64)]
     lib.dtp_parser_set_test_delay_ms.argtypes = [C.c_void_p, C.c_int]
     lib.dtp_parser_bytes_read.restype = C.c_int64
@@ -264,6 +266,11 @@ class NativeTextParser(Parser):
             index = arr(index64, z, np.uint64)
         if self.index_dtype != index.dtype:
             index = index.astype(self.index_dtype)  # widen requested u64
+        # engine-computed feature-index range: saves consumers an O(nnz)
+        # idx.max() rescan (mn > mx is the "no features" sentinel)
+        mn = C.c_uint64()
+        mx = C.c_uint64()
+        self._lib.dtp_block_index_range(block, C.byref(mn), C.byref(mx))
         self._block = RowBlock(
             offset=arr(offset, n + 1, np.int64),
             label=arr(label, n, np.float32),
@@ -271,7 +278,8 @@ class NativeTextParser(Parser):
             value=arr(value, z, np.float32),
             weight=arr(weight, n, np.float32) if hw.value else None,
             qid=arr(qid, n, np.int64) if hq.value else None,
-            field=arr(field, z, np.int64) if hf.value else None)
+            field=arr(field, z, np.int64) if hf.value else None,
+            max_index=int(mx.value) if mn.value <= mx.value else None)
         self._block.lease = lease
         self._lease = lease
         return True
@@ -290,14 +298,18 @@ class NativeTextParser(Parser):
 
     def stats(self) -> Dict[str, int]:
         """Pipeline stage timings of the current/last run (ns): reader
-        busy, parse busy (summed over workers), wall, plus chunk count
-        and queue depths. reader+parse > wall proves stage overlap."""
-        out = (C.c_int64 * 6)()
+        busy, parse busy (wall, summed over workers), wall, chunk count,
+        queue depths, and parse CPU (thread CPU time, summed — the honest
+        per-core kernel rate: wall inflates when workers are preempted,
+        e.g. by the consumer on a 1-core host). reader+parse > wall
+        proves stage overlap."""
+        out = (C.c_int64 * 7)()
         self._lib.dtp_parser_stats(self._handle, out)
         return {"reader_busy_ns": int(out[0]), "parse_busy_ns": int(out[1]),
                 "wall_ns": int(out[2]), "chunks": int(out[3]),
                 "max_chunk_queue_depth": int(out[4]),
-                "max_reorder_depth": int(out[5])}
+                "max_reorder_depth": int(out[5]),
+                "parse_cpu_ns": int(out[6])}
 
     def set_test_delay_ms(self, ms: int) -> None:
         """Test hook: add a per-chunk parse delay (pipeline-scaling proof
@@ -415,10 +427,11 @@ class NativeRecordIOReader:
         return int(self._lib.dtp_recio_total_size(self._handle))
 
     def stats(self) -> Dict[str, int]:
-        out = (C.c_int64 * 6)()
+        out = (C.c_int64 * 7)()
         self._lib.dtp_recio_stats(self._handle, out)
         return {"reader_busy_ns": int(out[0]), "decode_busy_ns": int(out[1]),
-                "wall_ns": int(out[2]), "chunks": int(out[3])}
+                "wall_ns": int(out[2]), "chunks": int(out[3]),
+                "decode_cpu_ns": int(out[6])}
 
     def destroy(self) -> None:
         if getattr(self, "_handle", None):

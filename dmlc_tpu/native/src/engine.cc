@@ -1008,19 +1008,35 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
     while (p < e && (is_nl(*p) || is_ws(*p))) ++p;
     if (p >= e) break;
     float label;
-    double dlabel;
     const char* q;
-    const char* pend = parse_f64_prefix(p, e, &dlabel);
-    if (pend && (pend == e || is_ws(*pend) || is_nl(*pend))) {
-      label = (float)dlabel;
-      q = pend;
+    // single-digit and sign+digit labels ("0", "1", "-1", "+1") are the
+    // overwhelming case in classification data: skip the general float
+    // machinery for them
+    unsigned ld0 = (unsigned)(p[0] - '0');
+    if (ld0 <= 9 && (p + 1 == e || is_ws(p[1]) || is_nl(p[1]))) {
+      label = (float)ld0;
+      q = p + 1;
+    } else if ((p[0] == '-' || p[0] == '+') && p + 1 < e &&
+               (unsigned)(p[1] - '0') <= 9 &&
+               (p + 2 == e || is_ws(p[2]) || is_nl(p[2]))) {
+      label = (float)(int)(p[1] - '0');
+      if (p[0] == '-') label = -label;
+      q = p + 2;
     } else {
-      const char* tok_end = p;
-      while (tok_end < e && !is_ws(*tok_end) && !is_nl(*tok_end)) ++tok_end;
-      if (!parse_f32(p, tok_end, &label))
-        throw EngineError{"libsvm: bad label '" + std::string(p, tok_end) +
-                          "'"};
-      q = tok_end;
+      double dlabel;
+      const char* pend = parse_f64_prefix(p, e, &dlabel);
+      if (pend && (pend == e || is_ws(*pend) || is_nl(*pend))) {
+        label = (float)dlabel;
+        q = pend;
+      } else {
+        const char* tok_end = p;
+        while (tok_end < e && !is_ws(*tok_end) && !is_nl(*tok_end))
+          ++tok_end;
+        if (!parse_f32(p, tok_end, &label))
+          throw EngineError{"libsvm: bad label '" + std::string(p, tok_end) +
+                            "'"};
+        q = tok_end;
+      }
     }
     int64_t qid = -1;
     size_t row_nnz = 0;
@@ -1036,33 +1052,46 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
       const char* s = q;
       if (*s == '+') ++s;  // golden contract allows '+'
       const char* dstart = s;
-      uint64_t w = load8(s, e);
-      int k = digit_run_len(w);
       uint64_t idx;
-      if (k < 8) {
-        // the whole index sits inside one 8-byte load (the byte at s+k
-        // is a non-digit, so the run IS the index)
-        idx = parse_digits_k_bl(w, k);
-        s += k;
+      // 1-2 digit indices ("3:1", "17:1" — the small-feature-space
+      // shape) skip the 8-byte gather machinery entirely (s can be e
+      // when the token was a lone '+' at the slice end)
+      unsigned i0 = (s < e) ? (unsigned)(s[0] - '0') : 10u;
+      unsigned i1 = (s + 2 < e) ? (unsigned)(s[1] - '0') : 10u;
+      if (i0 <= 9 && s + 1 < e && s[1] == ':') {
+        idx = i0;
+        s += 1;
+      } else if (i0 <= 9 && i1 <= 9 && s[2] == ':') {
+        idx = i0 * 10 + i1;
+        s += 2;
       } else {
-        // ≥8-digit index: seed with the 8 digits already classified,
-        // then bulk loop + tail with exact overflow semantics
-        idx = parse8(w);
-        s += 8;
-        while (s < e) {  // SWAR bulk: first ≤19 digits can't overflow
-          w = load8(s, e);
-          int kk = digit_run_len(w);
-          if (kk == 0 || (s - dstart) + kk > 19) break;
-          idx = idx * kPow10U64[kk] + parse_digits_k(w, kk);
-          s += kk;
-          if (kk < 8) break;
-        }
-        while (s < e) {  // tail with exact overflow semantics
-          unsigned d = (unsigned)(*s - '0');
-          if (d > 9) break;
-          if (idx > (UINT64_MAX - d) / 10) { s = dstart; break; }  // overflow
-          idx = idx * 10 + d;
-          ++s;
+        uint64_t w = load8(s, e);
+        int k = digit_run_len(w);
+        if (k < 8) {
+          // the whole index sits inside one 8-byte load (the byte at
+          // s+k is a non-digit, so the run IS the index)
+          idx = parse_digits_k_bl(w, k);
+          s += k;
+        } else {
+          // ≥8-digit index: seed with the 8 digits already classified,
+          // then bulk loop + tail with exact overflow semantics
+          idx = parse8(w);
+          s += 8;
+          while (s < e) {  // SWAR bulk: first ≤19 digits can't overflow
+            w = load8(s, e);
+            int kk = digit_run_len(w);
+            if (kk == 0 || (s - dstart) + kk > 19) break;
+            idx = idx * kPow10U64[kk] + parse_digits_k(w, kk);
+            s += kk;
+            if (kk < 8) break;
+          }
+          while (s < e) {  // tail with exact overflow semantics
+            unsigned d = (unsigned)(*s - '0');
+            if (d > 9) break;
+            if (idx > (UINT64_MAX - d) / 10) { s = dstart; break; }
+            idx = idx * 10 + d;
+            ++s;
+          }
         }
       }
       if (s == dstart || s >= e || *s != ':') {
@@ -1089,16 +1118,24 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
       }
       const char* vb = ++s;
       float val;
-      double dval;
-      const char* vend = parse_f64_prefix(vb, e, &dval);
-      if (vend && (vend == e || is_ws(*vend) || is_nl(*vend))) {
-        val = (float)dval;
-        s = vend;
+      // single-digit values (":1" binary features) skip the general
+      // float machinery — the dominant case in a1a-shaped data
+      unsigned vd0 = vb < e ? (unsigned)(vb[0] - '0') : 10u;
+      if (vd0 <= 9 && (vb + 1 == e || is_ws(vb[1]) || is_nl(vb[1]))) {
+        val = (float)vd0;
+        s = vb + 1;
       } else {
-        while (s < e && !is_ws(*s) && !is_nl(*s)) ++s;
-        if (!parse_f32(vb, s, &val))
-          throw EngineError{"libsvm: bad feature token '" +
-                            std::string(q, s) + "'"};
+        double dval;
+        const char* vend = parse_f64_prefix(vb, e, &dval);
+        if (vend && (vend == e || is_ws(*vend) || is_nl(*vend))) {
+          val = (float)dval;
+          s = vend;
+        } else {
+          while (s < e && !is_ws(*s) && !is_nl(*s)) ++s;
+          if (!parse_f32(vb, s, &val))
+            throw EngineError{"libsvm: bad feature token '" +
+                              std::string(q, s) + "'"};
+        }
       }
       if (!a->wide && idx <= UINT32_MAX) {
         // unchecked write: capacity bounded by the bytes/4+1 reserve
@@ -1344,6 +1381,17 @@ inline int64_t now_ns() {
       .count();
 }
 
+// per-thread CPU time: used for the parse-busy stat so that "busy"
+// means cycles actually spent parsing. Wall-clock deltas inflate under
+// preemption (on a 1-core host the consumer thread timeshares with the
+// workers and a chunk's wall time can be several times its CPU time),
+// which made the per-core parse rate look slower than the kernel is.
+inline int64_t thread_cpu_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_THREAD_CPUTIME_ID, &ts);
+  return (int64_t)ts.tv_sec * 1000000000 + ts.tv_nsec;
+}
+
 template <typename T>
 class BoundedQueue {
  public:
@@ -1480,7 +1528,12 @@ class OrderedQueue {
 
 struct PipelineStats {
   std::atomic<int64_t> reader_busy_ns{0};   // time inside NextChunk
-  std::atomic<int64_t> parse_busy_ns{0};    // summed across workers
+  std::atomic<int64_t> parse_busy_ns{0};    // wall, summed across workers
+  std::atomic<int64_t> parse_cpu_ns{0};     // thread CPU, summed — the
+                                            // honest per-core kernel rate
+                                            // (wall inflates when workers
+                                            // are preempted; see
+                                            // thread_cpu_ns)
   std::atomic<int64_t> chunks{0};
   int64_t start_ns = now_ns();  // sane wall even before the first run
   std::atomic<int64_t> end_ns{0};           // set at end (incl. error)
@@ -1488,6 +1541,7 @@ struct PipelineStats {
   void Reset() {
     reader_busy_ns = 0;
     parse_busy_ns = 0;
+    parse_cpu_ns = 0;
     chunks = 0;
     start_ns = now_ns();
     end_ns = 0;
@@ -1623,6 +1677,7 @@ struct ParserHandle {
         while (chunks->Pop(&item)) {
           BlockItem out;
           int64_t t0 = now_ns();
+          int64_t c0 = thread_cpu_ns();
           if (test_delay_ms > 0)
             std::this_thread::sleep_for(
                 std::chrono::milliseconds(test_delay_ms));
@@ -1637,6 +1692,7 @@ struct ParserHandle {
             out.error = ex.what();
           }
           stats.parse_busy_ns += now_ns() - t0;
+          stats.parse_cpu_ns += thread_cpu_ns() - c0;
           if (!item.view) RecycleChunkBuf(std::move(item.data));
           if (!blocks->Push(item.seq, std::move(out))) break;
         }
@@ -1806,6 +1862,7 @@ struct RecordIOHandle {
       }
       if (!batch) batch = std::make_unique<RecBatch>();
       int64_t t0 = now_ns();
+      int64_t c0 = thread_cpu_ns();
       try {
         if (item.view &&
             DecodeRecordIOViews(item.view, item.view_len, batch.get())) {
@@ -1834,6 +1891,7 @@ struct RecordIOHandle {
         return -1;
       }
       stats.parse_busy_ns += now_ns() - t0;
+      stats.parse_cpu_ns += thread_cpu_ns() - c0;
       if (batch->starts.empty()) {  // no complete records
         std::lock_guard<std::mutex> lk(pool_mu);
         batch_pool.push_back(std::move(batch));
@@ -1972,6 +2030,16 @@ void dtp_parser_before_first(void* handle) {
   // pipeline restarts lazily on next()
 }
 
+// Per-block feature-index range, computed during parse (libsvm/libfm: a
+// single vectorizable pass; CSV: derived from the column count). Lets
+// the Python side skip an O(nnz) idx.max() rescan when aggregating
+// blocks. mn > mx (the empty sentinel) means the block has no features.
+void dtp_block_index_range(void* block, uint64_t* mn, uint64_t* mx) {
+  auto* a = static_cast<CSRArena*>(block);
+  *mn = a->min_index;
+  *mx = a->max_index;
+}
+
 // Return a block's arena to the pool (see dtp_parser_next contract).
 void dtp_block_release(void* handle, void* block) {
   if (!handle || !block) return;
@@ -1979,9 +2047,10 @@ void dtp_block_release(void* handle, void* block) {
       static_cast<CSRArena*>(block));
 }
 
-// Stage timings + pipeline shape of the current/last run. out[6]:
-// [reader_busy_ns, parse_busy_ns (summed over workers), wall_ns,
-//  chunks, max_chunk_queue_depth, max_reorder_depth]
+// Stage timings + pipeline shape of the current/last run. out[7]:
+// [reader_busy_ns, parse_busy_ns (wall, summed over workers), wall_ns,
+//  chunks, max_chunk_queue_depth, max_reorder_depth,
+//  parse_cpu_ns (thread CPU, summed — the honest per-core kernel rate)]
 // reader_busy + parse_busy > wall proves IO/parse (or parse/parse)
 // overlap; parse_busy/wall ~ N proves N-way parse scaling.
 void dtp_parser_stats(void* handle, int64_t* out) {
@@ -1995,6 +2064,7 @@ void dtp_parser_stats(void* handle, int64_t* out) {
                                : h->max_chunk_depth);
   out[5] = (int64_t)(h->blocks ? h->blocks->max_depth()
                                : h->max_reorder_depth);
+  out[6] = h->stats.parse_cpu_ns.load();
 }
 
 // Test hook: make every chunk "parse" take >= ms extra. Lets a 1-core
@@ -2087,6 +2157,7 @@ void dtp_recio_stats(void* handle, int64_t* out) {
   out[3] = h->stats.chunks.load();
   out[4] = 0;
   out[5] = 0;
+  out[6] = h->stats.parse_cpu_ns.load();
 }
 
 void dtp_recio_destroy(void* handle) {
