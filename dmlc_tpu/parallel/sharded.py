@@ -142,6 +142,12 @@ class ShardedRowBlockIter:
         self.row_bucket = row_bucket
         self.nnz_bucket = nnz_bucket
         self.index_dtype = np.dtype(index_dtype)
+        # rounds-per-epoch, agreed collectively during the FIRST epoch and
+        # cached: replay is deterministic (same uri/parts/buckets), so
+        # later epochs run with ZERO per-batch collectives — matching the
+        # reference, whose distributed story (input_split_base.cc) has no
+        # cross-worker communication at all once shards are assigned
+        self._rounds_per_epoch: Optional[int] = None
         axis_idx = list(mesh.axis_names).index(axis)
         total_parts = mesh.devices.shape[axis_idx]
         local = [d for d in mesh.local_devices]
@@ -160,13 +166,13 @@ class ShardedRowBlockIter:
 
     def _block_streams(self) -> Iterator[List[RowBlock]]:
         """Lockstep streams: one (possibly empty) block per local part."""
-        from dmlc_tpu.parallel.sharded import empty_block  # self-import ok
         its = []
         for p in self._parsers:
             p.before_first()
             its.append(self._rechunk(p))
         done = [False] * len(its)
-        while True:
+
+        def next_row() -> List[RowBlock]:
             row = []
             for i, it in enumerate(its):
                 if done[i]:
@@ -177,8 +183,25 @@ class ShardedRowBlockIter:
                 except StopIteration:
                     done[i] = True
                     row.append(empty_block(self.index_dtype))
+            return row
+
+        if self._rounds_per_epoch is not None:
+            # steady state: the round count was agreed in epoch 1 and the
+            # streams replay deterministically — no collectives at all
+            for _ in range(self._rounds_per_epoch):
+                yield next_row()
+            return
+        # first epoch: per-round done-flag agreement (skewed shards make a
+        # process exhaust early; it must keep yielding empty batches until
+        # ALL are done — batch count is a collective contract), counting
+        # rounds so every later epoch skips the collective entirely
+        rounds = 0
+        while True:
+            row = next_row()
             if self._all_processes_done(all(done)):
+                self._rounds_per_epoch = rounds
                 return
+            rounds += 1
             yield row
 
     @staticmethod

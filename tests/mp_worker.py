@@ -60,16 +60,39 @@ def main() -> int:
         return h.hexdigest()
 
     if phase == "train":
+        # count host collectives per epoch: epoch 1 agrees on the round
+        # count (one done-flag allgather per round), later epochs must
+        # run with ZERO per-batch collectives (VERDICT r2 #3 — the
+        # reference has no cross-worker comm at all during iteration)
+        from jax.experimental import multihost_utils
+        orig_ag = multihost_utils.process_allgather
+        ag_calls = [0]
+
+        def _counting_ag(*a, **k):
+            ag_calls[0] += 1
+            return orig_ag(*a, **k)
+
+        multihost_utils.process_allgather = _counting_ag
         nbatches = 0
         last_loss = None
-        for _epoch in range(2):
-            for batch in it:
-                params, loss = step_fn(params, batch)
-                nbatches += 1
-                last_loss = float(loss)
+        epoch_batches = []
+        epoch_collectives = []
+        try:
+            for _epoch in range(2):
+                nb0, ag0 = nbatches, ag_calls[0]
+                for batch in it:
+                    params, loss = step_fn(params, batch)
+                    nbatches += 1
+                    last_loss = float(loss)
+                epoch_batches.append(nbatches - nb0)
+                epoch_collectives.append(ag_calls[0] - ag0)
+        finally:
+            multihost_utils.process_allgather = orig_ag
         ck.save(nbatches, params, metadata={"nbatches": nbatches})
         result = {"rank": pid, "world": nprocs, "nbatches": nbatches,
                   "loss": last_loss, "params_digest": digest(params),
+                  "epoch_batches": epoch_batches,
+                  "epoch_collectives": epoch_collectives,
                   "w_head": np.asarray(params["w"])[:8].tolist()}
     elif phase == "restore":
         restored, user = ck.restore(like=params)
