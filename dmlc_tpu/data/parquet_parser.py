@@ -82,6 +82,11 @@ class ParquetParser(Parser):
         fi, gi = self._groups[self._pos]
         self._pos += 1
         meta = self._files[fi].metadata.row_group(gi)
+        # decode relies on pyarrow's default use_threads=True: Arrow's
+        # C++ pool decompresses columns in parallel with the GIL
+        # released, so the decode wall (~0.7 GB/s compressed for snappy
+        # on one core — the measured single-core ceiling of this config)
+        # scales with cores on real hosts
         table = self._files[fi].read_row_group(gi)
         self._bytes += sum(meta.column(c).total_compressed_size
                            for c in range(meta.num_columns))
@@ -108,21 +113,79 @@ class ParquetParser(Parser):
             self._prefetch.destroy()
             self._prefetch = None
 
+    @staticmethod
+    def _zero_copy_columns(table, names) -> Optional[List[np.ndarray]]:
+        """Arrow columns → contiguous float numpy views without a
+        conversion copy (combine_chunks still concatenates when a column
+        arrives multi-chunk — single-chunk row-group reads don't), or
+        None when any column needs real conversion (nulls, non-float
+        dtypes, non-contiguous) — callers then take the general path."""
+        cols: List[np.ndarray] = []
+        for n in names:
+            col = table.column(n)
+            if col.null_count:
+                return None
+            if hasattr(col, "combine_chunks"):
+                col = col.combine_chunks()
+            try:
+                arr = col.to_numpy(zero_copy_only=True)
+            except Exception:  # noqa: BLE001 - pyarrow raises ArrowInvalid
+                return None
+            if (arr.dtype not in (np.float32, np.float64)
+                    or not arr.flags["C_CONTIGUOUS"]):
+                return None
+            cols.append(arr)
+        return cols
+
+    def _dense_values(self, table, names) -> np.ndarray:
+        """Row-major [nrow*ncol] f32 cell values. Hot path: zero-copy
+        Arrow buffers → native cache-blocked interleave (ctypes releases
+        the GIL, so this overlaps with the prefetch thread's next
+        read_row_group). Fallback: numpy stack."""
+        nrow = table.num_rows
+        if not names:
+            return np.zeros(0, np.float32)
+        from dmlc_tpu.native import native_available
+        if native_available():
+            cols = self._zero_copy_columns(table, names)
+            if cols is not None:
+                from dmlc_tpu.native.bindings import columns_interleave
+                return columns_interleave(cols)
+        cols = [table.column(n).to_numpy(zero_copy_only=False)
+                .astype(np.float32, copy=False) for n in names]
+        return np.stack(cols, axis=1).reshape(-1)
+
+    def _dense_skeleton(self, nrow: int, ncol: int):
+        """offset/index for a dense block are fully determined by the
+        shape — cache them across row groups (all groups but the last
+        share a shape). Consecutive blocks then SHARE these arrays by
+        reference; that is safe because RowBlock arrays are immutable by
+        contract and the container only ever concatenates them into new
+        arrays — never mutates in place."""
+        key = (nrow, ncol)
+        if getattr(self, "_skel_key", None) != key:
+            self._skel_key = key
+            self._skel = (np.arange(nrow + 1, dtype=np.int64) * ncol,
+                          np.tile(np.arange(ncol, dtype=self.index_dtype),
+                                  nrow))
+        return self._skel
+
     def _table_to_block(self, table) -> RowBlock:
         lcol, wcol = self.param.label_column, self.param.weight_column
         names = [n for n in table.column_names if n not in (lcol, wcol)]
-        cols = [table.column(n).to_numpy(zero_copy_only=False)
-                .astype(np.float32) for n in names]
         nrow = table.num_rows
-        ncol = len(cols)
-        dense = np.stack(cols, axis=1) if ncol else np.zeros((nrow, 0),
-                                                             np.float32)
+        ncol = len(names)
         label = (table.column(lcol).to_numpy(zero_copy_only=False)
-                 .astype(np.float32) if lcol else np.zeros(nrow, np.float32))
+                 .astype(np.float32, copy=False) if lcol
+                 else np.zeros(nrow, np.float32))
         weight = (table.column(wcol).to_numpy(zero_copy_only=False)
-                  .astype(np.float32) if wcol else None)
+                  .astype(np.float32, copy=False) if wcol else None)
         if self.param.sparse:
             # sparse column path: keep only non-zero cells, vectorized
+            cols = [table.column(n).to_numpy(zero_copy_only=False)
+                    .astype(np.float32, copy=False) for n in names]
+            dense = np.stack(cols, axis=1) if ncol else np.zeros(
+                (nrow, 0), np.float32)
             mask = dense != 0
             offset = np.zeros(nrow + 1, np.int64)
             np.cumsum(mask.sum(axis=1), out=offset[1:])
@@ -131,10 +194,11 @@ class ParquetParser(Parser):
             return RowBlock(offset=offset, label=label,
                             index=cols_idx.astype(self.index_dtype),
                             value=dense[mask], weight=weight)
-        offset = np.arange(nrow + 1, dtype=np.int64) * ncol
-        index = np.tile(np.arange(ncol, dtype=self.index_dtype), nrow)
+        value = self._dense_values(table, names)
+        offset, index = self._dense_skeleton(nrow, ncol)
         return RowBlock(offset=offset, label=label, index=index,
-                        value=dense.reshape(-1), weight=weight)
+                        value=value, weight=weight,
+                        max_index=ncol - 1 if ncol else None)
 
     def value(self) -> RowBlock:
         check(self._block is not None, "value() before successful next()")

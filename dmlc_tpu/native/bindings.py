@@ -60,6 +60,9 @@ def load(path: str):
     lib.dtp_block_release.argtypes = [C.c_void_p, C.c_void_p]
     lib.dtp_block_index_range.argtypes = [
         C.c_void_p, C.POINTER(C.c_uint64), C.POINTER(C.c_uint64)]
+    lib.dtp_columns_interleave.argtypes = [
+        C.POINTER(C.c_void_p), C.POINTER(C.c_int32), C.c_int64, C.c_int64,
+        C.POINTER(C.c_float)]
     lib.dtp_parser_stats.argtypes = [C.c_void_p, C.POINTER(C.c_int64)]
     lib.dtp_parser_set_test_delay_ms.argtypes = [C.c_void_p, C.c_int]
     lib.dtp_parser_bytes_read.restype = C.c_int64
@@ -112,6 +115,24 @@ def _local_split_files(uri: str):
         check(os.path.exists(p),
               f"native engine requires local files, got {p!r}")
     return files
+
+
+def columns_interleave(cols) -> np.ndarray:
+    """Interleave contiguous float32/float64 column arrays into one
+    row-major float32 array of shape [nrow * ncol] via the native
+    cache-blocked transpose (the hot half of Parquet/Arrow ingest).
+    Caller guarantees equal lengths, float dtypes, C-contiguity."""
+    lib = _get_lib()
+    ncol = len(cols)
+    nrow = len(cols[0]) if ncol else 0
+    out = np.empty(nrow * ncol, np.float32)
+    ptrs = (C.c_void_p * ncol)(
+        *[c.ctypes.data_as(C.c_void_p).value for c in cols])
+    dts = (C.c_int32 * ncol)(
+        *[0 if c.dtype == np.float32 else 1 for c in cols])
+    lib.dtp_columns_interleave(ptrs, dts, ncol, nrow,
+                               out.ctypes.data_as(C.POINTER(C.c_float)))
+    return out
 
 
 def native_parse_float32(token: bytes) -> np.float32:

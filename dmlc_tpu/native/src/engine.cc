@@ -2030,6 +2030,31 @@ void dtp_parser_before_first(void* handle) {
   // pipeline restarts lazily on next()
 }
 
+// Columnar → row-major interleave for the Parquet/Arrow ingest path
+// (BASELINE config 5; the reference has no Parquet parser — this is the
+// native half of the new capability). cols[i] points at column i's
+// contiguous values buffer (no nulls — the Python side falls back when
+// validity bitmaps are present); dtypes[i]: 0 = float32, 1 = float64.
+// Cache-blocked over rows so the strided writes stay inside L1/L2 —
+// numpy's np.stack pays a full strided pass per column instead.
+void dtp_columns_interleave(const void** cols, const int32_t* dtypes,
+                            int64_t ncol, int64_t nrow, float* out) {
+  constexpr int64_t kBlock = 256;
+  for (int64_t r0 = 0; r0 < nrow; r0 += kBlock) {
+    const int64_t bn = std::min(nrow - r0, kBlock);
+    for (int64_t c = 0; c < ncol; ++c) {
+      float* o = out + r0 * ncol + c;
+      if (dtypes[c] == 0) {
+        const float* src = (const float*)cols[c] + r0;
+        for (int64_t r = 0; r < bn; ++r, o += ncol) *o = src[r];
+      } else {
+        const double* src = (const double*)cols[c] + r0;
+        for (int64_t r = 0; r < bn; ++r, o += ncol) *o = (float)src[r];
+      }
+    }
+  }
+}
+
 // Per-block feature-index range, computed during parse (libsvm/libfm: a
 // single vectorizable pass; CSV: derived from the column count). Lets
 // the Python side skip an O(nnz) idx.max() rescan when aggregating

@@ -75,6 +75,54 @@ class TestParquetParser:
             block.label, table.column("label").to_numpy())
 
 
+class TestNativeInterleave:
+    """The native cache-blocked column interleave must be value-identical
+    to the numpy fallback on every dtype/fallback combination."""
+
+    def test_native_matches_fallback(self, tmp_path, rng, monkeypatch):
+        from dmlc_tpu.native import native_available
+        if not native_available():
+            pytest.skip("native engine unavailable")
+        n = 777  # not a multiple of the native row block (256)
+        cols = {"label": pa.array(rng.randint(0, 2, n).astype(np.float32)),
+                "a32": pa.array(rng.rand(n).astype(np.float32)),
+                "b64": pa.array(rng.rand(n)),  # float64 column
+                "c32": pa.array(rng.randn(n).astype(np.float32))}
+        path = str(tmp_path / "mix.parquet")
+        pq.write_table(pa.table(cols), path, row_group_size=250)
+        pn = Parser.create(path, 0, 1, format="parquet",
+                           label_column="label")
+        native = drain(pn)
+        pn.destroy()
+        import dmlc_tpu.data.parquet_parser as pp
+        monkeypatch.setattr(pp, "ParquetParser", pp.ParquetParser)
+        import dmlc_tpu.native as nat
+        monkeypatch.setattr(nat, "native_available", lambda: False)
+        pf = Parser.create(path, 0, 1, format="parquet",
+                           label_column="label")
+        fallback = drain(pf)
+        pf.destroy()
+        assert native.content_hash() == fallback.content_hash()
+
+    def test_null_column_falls_back(self, tmp_path, rng):
+        n = 60
+        vals = [None if i % 7 == 0 else float(i) for i in range(n)]
+        t = pa.table({"label": pa.array(np.zeros(n, np.float32)),
+                      "f": pa.array(vals, pa.float32())})
+        path = str(tmp_path / "nulls.parquet")
+        pq.write_table(t, path)
+        p = Parser.create(path, 0, 1, format="parquet",
+                          label_column="label")
+        block = drain(p)
+        p.destroy()
+        got = np.asarray(block.value)
+        want = np.array([np.nan if v is None else v for v in vals],
+                        np.float32)
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+        np.testing.assert_array_equal(got[~np.isnan(want)],
+                                      want[~np.isnan(want)])
+
+
 class TestSparseColumnPath:
     def test_sparse_drops_zeros_dense_parity(self, tmp_path):
         import pyarrow as pa
