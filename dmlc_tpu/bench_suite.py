@@ -119,6 +119,25 @@ def make_recordio(prefix: str, mb: int, nparts: int = 4,
     return paths
 
 
+def make_indexed_recordio(path: str, mb: int, seed: int = 0) -> int:
+    """ImageNet-.rec-shaped single file + .idx (key\\toffset) index."""
+    from dmlc_tpu.io.recordio import IndexedRecordIOWriter
+    from dmlc_tpu.io.stream import create_stream
+    if (os.path.exists(path) and os.path.exists(path + ".idx")
+            and os.path.getsize(path) >= (mb << 20) * 3 // 4):
+        return os.path.getsize(path)
+    rng = np.random.RandomState(seed)
+    with create_stream(path, "w") as s, \
+            create_stream(path + ".idx", "w") as ix:
+        w = IndexedRecordIOWriter(s, ix)
+        written = 0
+        while written < (mb << 20):
+            rec = rng.bytes(rng.randint(60_000, 140_000))
+            w.write_record(rec)
+            written += len(rec) + 8
+    return os.path.getsize(path)
+
+
 def make_parquet(path: str, mb: int, seed: int = 0) -> int:
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -374,12 +393,84 @@ def bench_parquet(mb: int) -> Dict:
             "hash": _content_hash(path, "parquet", label_column="label")}
 
 
+def bench_indexed_shuffled(mb: int) -> Dict:
+    """Shuffled indexed-RecordIO reads — the ImageNet .rec TRAINING
+    access pattern (reference: src/io/indexed_recordio_split.cc): seeded
+    per-epoch batch shuffle, index-driven seeks. Native data plane vs
+    the Python golden, identical record order asserted by digest."""
+    import hashlib
+
+    path = f"{_TMP}.imagenet.indexed.rec"
+    size = make_indexed_recordio(path, mb)
+    from dmlc_tpu.native import native_available
+
+    def py_epoch(seed):
+        from dmlc_tpu.io.indexed_recordio_split import IndexedRecordIOSplit
+        sp = IndexedRecordIOSplit(path, 0, 1, shuffle=True, seed=seed,
+                                  batch_size=64)
+        recs = []
+        t0 = time.perf_counter()
+        while True:
+            rec = sp.next_record()
+            if rec is None:
+                break
+            recs.append(rec)
+        dt = time.perf_counter() - t0
+        # digest OUTSIDE the timed region in both paths (hashing costs
+        # more than the reads; the timed work is reads only)
+        digest = hashlib.sha256()
+        for rec in recs:
+            digest.update(hashlib.sha256(rec).digest())
+        return dt, len(recs), digest.hexdigest()[:16]
+
+    def native_epoch(seed):
+        from dmlc_tpu.native.bindings import NativeIndexedRecordIOReader
+        r = NativeIndexedRecordIOReader(path, 0, 1, shuffle=True,
+                                        seed=seed, batch_size=64)
+        digest = hashlib.sha256()
+        nrec = 0
+        t0 = time.perf_counter()
+        batches = []
+        while True:
+            batch = r.next_batch()
+            if batch is None:
+                break
+            data, starts, ends = batch
+            nrec += len(starts)
+            batches.append((data, starts, ends, r.detach()))
+        dt = time.perf_counter() - t0
+        # digest untimed, mirroring py_epoch
+        for data, starts, ends, lease in batches:
+            view = memoryview(data)
+            for i in range(len(starts)):
+                digest.update(hashlib.sha256(
+                    view[int(starts[i]):int(ends[i])]).digest())
+            if lease is not None:
+                lease.release()
+        r.destroy()
+        return dt, nrec, digest.hexdigest()[:16]
+
+    py_dt, py_n, py_h = py_epoch(11)
+    if native_available():
+        nat_dt, nat_n, nat_h = native_epoch(11)
+    else:
+        nat_dt, nat_n, nat_h = py_dt, py_n, py_h
+    assert (py_n, py_h) == (nat_n, nat_h), \
+        f"order/content mismatch: py={py_n}/{py_h} native={nat_n}/{nat_h}"
+    return {"config": "indexed_recordio_shuffled",
+            "gbps": size / nat_dt / 1e9, "bytes": size, "records": nat_n,
+            "python_gbps": round(size / py_dt / 1e9, 4),
+            "speedup_vs_python": round(py_dt / nat_dt, 2),
+            "hash": nat_h}
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
     3: ("recordio", lambda mb, dev: bench_recordio(mb)),
     4: ("prefetch", bench_prefetch),
     5: ("parquet", lambda mb, dev: bench_parquet(mb)),
+    6: ("indexed_shuffled", lambda mb, dev: bench_indexed_shuffled(mb)),
 }
 
 

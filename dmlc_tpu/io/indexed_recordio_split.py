@@ -96,6 +96,24 @@ class IndexedRecordIOSplit(InputSplit):
         """Index keys of this part's records, in current read order."""
         return [self._mine[i][0] for i in self._order]
 
+    def record_windows(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(offsets, sizes) int64 arrays of this part's record windows in
+        table order — the data-plane contract for block readers (the
+        native engine maps the file and reads windows by id)."""
+        offs = np.array([e[1] for e in self._mine], np.int64)
+        sizes = np.array([e[2] for e in self._mine], np.int64)
+        return offs, sizes
+
+    def next_order_batch(self) -> Optional[np.ndarray]:
+        """Record ids (into the part's window table) of the next batch in
+        the current epoch order; advances the cursor. None when the epoch
+        is exhausted. Shares the cursor with next_record/next_chunk."""
+        if self._pos >= len(self._order):
+            return None
+        b = self._order[self._pos:self._pos + self._batch_size]
+        self._pos += len(b)
+        return np.ascontiguousarray(b, np.int64)
+
     def next_record(self) -> Optional[bytes]:
         if self._pos >= len(self._order):
             return None

@@ -23,7 +23,8 @@ from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
            "NativeCSVParser", "NativeLibFMParser", "NativeRecordIOReader",
-           "native_parse_float32"]
+           "NativeIndexedRecordIOReader", "native_parse_float32",
+           "columns_interleave"]
 
 _lib = None
 
@@ -89,6 +90,19 @@ def load(path: str):
     lib.dtp_recio_total_size.argtypes = [C.c_void_p]
     lib.dtp_recio_stats.argtypes = [C.c_void_p, C.POINTER(C.c_int64)]
     lib.dtp_recio_destroy.argtypes = [C.c_void_p]
+    lib.dtp_recidx_create.restype = C.c_void_p
+    lib.dtp_recidx_create.argtypes = [
+        C.c_char_p, C.POINTER(C.c_int64), C.POINTER(C.c_int64), C.c_int64]
+    lib.dtp_recidx_read_batch.restype = C.c_int64
+    lib.dtp_recidx_read_batch.argtypes = [
+        C.c_void_p, C.POINTER(C.c_int64), C.c_int64,
+        C.POINTER(C.c_void_p), C.POINTER(C.POINTER(C.c_uint8)),
+        C.POINTER(C.POINTER(C.c_int64)), C.POINTER(C.POINTER(C.c_int64)),
+    ]
+    lib.dtp_recidx_release.argtypes = [C.c_void_p, C.c_void_p]
+    lib.dtp_recidx_bytes_read.restype = C.c_int64
+    lib.dtp_recidx_bytes_read.argtypes = [C.c_void_p]
+    lib.dtp_recidx_destroy.argtypes = [C.c_void_p]
     lib.dtp_parse_float32.restype = C.c_int
     lib.dtp_parse_float32.argtypes = [C.c_char_p, C.c_int64,
                                       C.POINTER(C.c_float)]
@@ -361,6 +375,127 @@ class _RecioLease(BlockLease):
     __slots__ = ()
 
     _release_fn = "dtp_recio_block_release"
+
+
+class _RecidxLease(BlockLease):
+    """Lease over an indexed-recordio batch."""
+
+    __slots__ = ()
+
+    _release_fn = "dtp_recidx_release"
+
+
+class NativeIndexedRecordIOReader:
+    """Shuffled random-access record reader over the native data plane
+    (reference: src/io/indexed_recordio_split.cc).
+
+    The Python golden (io.indexed_recordio_split.IndexedRecordIOSplit)
+    owns index parsing, byte-range partitioning, and the seeded per-epoch
+    batch shuffle — so ordering semantics are IDENTICAL by construction.
+    The native handle maps the data file once; ``next_batch()`` returns
+    one shuffled batch's payloads as zero-copy spans into the mapping
+    (single-frame records; multi-frame batches stitch into a pooled
+    buffer). Same lease contract as NativeRecordIOReader."""
+
+    def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1,
+                 index_uri: Optional[str] = None, shuffle: bool = False,
+                 seed: int = 0, batch_size: int = 256):
+        from dmlc_tpu.io.indexed_recordio_split import IndexedRecordIOSplit
+        lib = _get_lib()
+        self._split = IndexedRecordIOSplit(
+            uri, part_index, num_parts, index_uri=index_uri,
+            shuffle=shuffle, seed=seed, batch_size=batch_size)
+        offs, sizes = self._split.record_windows()
+        self._lib = lib
+        self._handle = lib.dtp_recidx_create(
+            self._split._data_uri.encode(),
+            offs.ctypes.data_as(C.POINTER(C.c_int64)),
+            sizes.ctypes.data_as(C.POINTER(C.c_int64)), len(offs))
+        if not self._handle:
+            raise DMLCError(f"native indexed recordio create failed: "
+                            f"{lib.dtp_last_error().decode()}")
+        self._lease: Optional[_RecidxLease] = None
+
+    def keys(self):
+        return self._split.keys()
+
+    def next_batch(self):
+        """(payload, starts, ends) numpy views for the next shuffled
+        batch's records, or None at end of epoch. Spans are in batch
+        order (record i = payload[starts[i]:ends[i]])."""
+        order = self._split.next_order_batch()
+        if order is None:
+            return None
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
+        block = C.c_void_p()
+        payload = C.POINTER(C.c_uint8)()
+        starts = C.POINTER(C.c_int64)()
+        ends = C.POINTER(C.c_int64)()
+        nrec = self._lib.dtp_recidx_read_batch(
+            self._handle, order.ctypes.data_as(C.POINTER(C.c_int64)),
+            len(order), C.byref(block), C.byref(payload), C.byref(starts),
+            C.byref(ends))
+        if nrec < 0:
+            # engine messages already carry the "indexed recordio:" prefix
+            raise DMLCError(self._lib.dtp_last_error().decode())
+        if nrec == 0:
+            return None
+        self._lease = _RecidxLease(self, block.value)
+        n = int(nrec)
+        s = np.ctypeslib.as_array(starts, shape=(n,))
+        e = np.ctypeslib.as_array(ends, shape=(n,))
+        # shuffled spans are not ascending: the view must cover max(ends)
+        data = np.ctypeslib.as_array(payload, shape=(int(e.max()),))
+        return data, s, e
+
+    def detach(self) -> Optional[_RecidxLease]:
+        lease, self._lease = self._lease, None
+        return lease
+
+    def records(self):
+        """Iterate the CURRENT epoch's remaining records as bytes
+        (copies). Does NOT rewind: with shuffle=True, before_first()
+        advances to the next epoch's permutation (golden semantics —
+        construction leaves epoch 0 ready), so rewinding here would
+        silently skip an epoch."""
+        while True:
+            batch = self.next_batch()
+            if batch is None:
+                return
+            data, starts, ends = batch
+            view = memoryview(data)
+            for i in range(len(starts)):
+                yield bytes(view[int(starts[i]):int(ends[i])])
+
+    def before_first(self) -> None:
+        """Rewind; with shuffle=True this advances to the next epoch's
+        permutation (the golden's reshuffle-per-epoch semantics)."""
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
+        self._split.before_first()
+
+    def bytes_read(self) -> int:
+        return int(self._lib.dtp_recidx_bytes_read(self._handle))
+
+    def get_total_size(self) -> int:
+        return self._split.get_total_size()
+
+    def destroy(self) -> None:
+        if getattr(self, "_handle", None):
+            if self._lease is not None:
+                self._lease.release()
+                self._lease = None
+            self._lib.dtp_recidx_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
 
 
 class NativeRecordIOReader:

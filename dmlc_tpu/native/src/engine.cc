@@ -1775,6 +1775,62 @@ struct ParserHandle {
 
 // reader thread -> bounded chunk queue -> consumer-side decode
 // (decode is memcpy-bound; the reader overlap is the win)
+// Pooled RecBatch leases + recycled owned chunk buffers, shared by both
+// record readers (the sharded pipeline and the indexed random-access
+// reader) so the lease/pool contract lives in exactly one place.
+struct RecBatchPool {
+  std::mutex mu;
+  std::vector<std::unique_ptr<RecBatch>> batches;
+  std::vector<std::string> chunk_bufs;
+  std::map<RecBatch*, std::unique_ptr<RecBatch>> outstanding;
+
+  std::unique_ptr<RecBatch> Get() {
+    std::lock_guard<std::mutex> lk(mu);
+    if (!batches.empty()) {
+      auto b = std::move(batches.back());
+      batches.pop_back();
+      b->clear();
+      return b;
+    }
+    return std::make_unique<RecBatch>();
+  }
+
+  void PutBack(std::unique_ptr<RecBatch> b) {
+    std::lock_guard<std::mutex> lk(mu);
+    batches.push_back(std::move(b));
+  }
+
+  // recycled owned buffer for the copy path (empty when none pooled);
+  // capacity survives Release round-trips
+  std::string TakeChunkBuf() {
+    std::lock_guard<std::mutex> lk(mu);
+    if (chunk_bufs.empty()) return std::string();
+    std::string s = std::move(chunk_bufs.back());
+    chunk_bufs.pop_back();
+    return s;
+  }
+
+  RecBatch* Lease(std::unique_ptr<RecBatch> b) {
+    RecBatch* raw = b.get();
+    std::lock_guard<std::mutex> lk(mu);
+    outstanding[raw] = std::move(b);
+    return raw;
+  }
+
+  void Release(RecBatch* b) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = outstanding.find(b);
+    if (it == outstanding.end()) return;
+    // hand an owned chunk buffer's capacity back (view batches own no
+    // bytes — the mapping belongs to the reader)
+    if (!it->second->vbase && chunk_bufs.size() < 6)
+      chunk_bufs.push_back(std::move(it->second->data));
+    it->second->clear();
+    batches.push_back(std::move(it->second));
+    outstanding.erase(it);
+  }
+};
+
 struct RecordIOHandle {
   std::unique_ptr<RecordIOShardReader> reader;
   std::unique_ptr<std::thread> reader_thread;
@@ -1784,10 +1840,7 @@ struct RecordIOHandle {
   std::string error;
   PipelineStats stats;
 
-  std::mutex pool_mu;
-  std::vector<std::unique_ptr<RecBatch>> batch_pool;
-  std::vector<std::string> chunk_pool;
-  std::map<RecBatch*, std::unique_ptr<RecBatch>> outstanding;
+  RecBatchPool pool;
   RecBatch* last = nullptr;
 
   ~RecordIOHandle() { StopPipeline(); }
@@ -1821,13 +1874,7 @@ struct RecordIOHandle {
             }
             more = (st == ShardReaderBase::kView);
           } else {
-            {
-              std::lock_guard<std::mutex> lk(pool_mu);
-              if (!chunk_pool.empty()) {
-                item.data = std::move(chunk_pool.back());
-                chunk_pool.pop_back();
-              }
-            }
+            item.data = pool.TakeChunkBuf();
             more = reader->NextChunk(&item.data);
           }
           stats.reader_busy_ns += now_ns() - t0;
@@ -1851,16 +1898,7 @@ struct RecordIOHandle {
     if (!chunks) StartPipeline();
     ChunkItem item;
     while (chunks->Pop(&item)) {
-      std::unique_ptr<RecBatch> batch;
-      {
-        std::lock_guard<std::mutex> lk(pool_mu);
-        if (!batch_pool.empty()) {
-          batch = std::move(batch_pool.back());
-          batch_pool.pop_back();
-          batch->clear();
-        }
-      }
-      if (!batch) batch = std::make_unique<RecBatch>();
+      std::unique_ptr<RecBatch> batch = pool.Get();
       int64_t t0 = now_ns();
       int64_t c0 = thread_cpu_ns();
       try {
@@ -1872,13 +1910,7 @@ struct RecordIOHandle {
           if (item.view) {
             // multi-frame records: copy into a POOLED buffer (its
             // capacity survives Release round-trips), then stitch
-            {
-              std::lock_guard<std::mutex> lk(pool_mu);
-              if (!chunk_pool.empty()) {
-                batch->data = std::move(chunk_pool.back());
-                chunk_pool.pop_back();
-              }
-            }
+            batch->data = pool.TakeChunkBuf();
             batch->data.assign(item.view, item.view_len);
           } else {
             batch->data = std::move(item.data);
@@ -1893,17 +1925,11 @@ struct RecordIOHandle {
       stats.parse_busy_ns += now_ns() - t0;
       stats.parse_cpu_ns += thread_cpu_ns() - c0;
       if (batch->starts.empty()) {  // no complete records
-        std::lock_guard<std::mutex> lk(pool_mu);
-        batch_pool.push_back(std::move(batch));
+        pool.PutBack(std::move(batch));
         continue;
       }
-      RecBatch* raw = batch.get();
-      {
-        std::lock_guard<std::mutex> lk(pool_mu);
-        outstanding[raw] = std::move(batch);
-      }
-      last = raw;
-      return (int64_t)raw->starts.size();
+      last = pool.Lease(std::move(batch));
+      return (int64_t)last->starts.size();
     }
     stats.end_ns = now_ns();
     if (reader_failed) {
@@ -1913,20 +1939,123 @@ struct RecordIOHandle {
     return 0;
   }
 
-  void Release(RecBatch* b) {
-    std::lock_guard<std::mutex> lk(pool_mu);
-    auto it = outstanding.find(b);
-    if (it == outstanding.end()) return;
-    // hand an owned chunk buffer's capacity back to the reader (view
-    // batches own no bytes — the mapping belongs to the reader)
-    if (!it->second->vbase && chunk_pool.size() < 6)
-      chunk_pool.push_back(std::move(it->second->data));
-    it->second->clear();
-    batch_pool.push_back(std::move(it->second));
-    outstanding.erase(it);
-  }
+  void Release(RecBatch* b) { pool.Release(b); }
 };
 
+
+// ------------------------------------------ indexed recordio (shuffled)
+// Random-access record reads driven by an index (reference:
+// src/io/indexed_recordio_split.cc — index-driven seeks + shuffled
+// batched reads). The Python side owns index parsing, partitioning and
+// the seeded epoch shuffle (io/indexed_recordio_split.py — the golden);
+// this handle owns the data plane: the file is mapped once and a batch
+// of records decodes to payload spans that are pure views into the map
+// when every record is single-frame (ImageNet .rec shape), falling back
+// to a pooled copy + in-place stitch otherwise. DMLC_TPU_NO_MMAP=1 (or
+// mmap failure) forces pread into the batch buffer.
+struct IndexedRecIOHandle {
+  int fd = -1;
+  const char* map = nullptr;
+  size_t map_len = 0;
+  std::vector<int64_t> offsets, sizes;
+  std::string error;
+  int64_t total_read = 0;
+
+  RecBatchPool pool;
+
+  ~IndexedRecIOHandle() {
+    if (map) munmap(const_cast<char*>(map), map_len);
+    if (fd >= 0) close(fd);
+  }
+
+  // windows [off, off+size) must stay inside the file
+  bool CheckWindow(int64_t i) {
+    if (i < 0 || (size_t)i >= offsets.size()) {
+      error = "indexed recordio: record id out of range";
+      return false;
+    }
+    if (offsets[i] < 0 || sizes[i] < 8 ||
+        (uint64_t)(offsets[i] + sizes[i]) > (uint64_t)map_len) {
+      error = "indexed recordio: index window outside the data file";
+      return false;
+    }
+    return true;
+  }
+
+  // Pure-view decode of one single-record window at absolute offset
+  // `off`: returns true and appends the payload span (absolute into the
+  // map) iff the window is one clean single-frame record.
+  bool ViewOne(int64_t off, int64_t size, RecBatch* out) {
+    const char* d = map + off;
+    if (load_u32le(d) != kRecIOMagic) {
+      error = "indexed recordio: invalid magic at indexed offset";
+      return false;
+    }
+    uint32_t lrec = load_u32le(d + 4);
+    uint32_t cflag = (lrec >> 29) & 7;
+    size_t clen = lrec & ((1u << 29) - 1);
+    if (cflag != 0 || 8 + (int64_t)clen > size) return false;
+    out->starts.push_back(off + 8);
+    out->ends.push_back(off + 8 + (int64_t)clen);
+    return true;
+  }
+
+  int64_t ReadBatch(const int64_t* order, int64_t count, RecBatch* out) {
+    // fast path: every window is a clean single-frame record → spans
+    // are views into the shared mapping, zero bytes copied
+    if (map) {
+      bool ok = true;
+      for (int64_t k = 0; k < count; ++k) {
+        if (!CheckWindow(order[k])) return -1;
+        if (!ViewOne(offsets[order[k]], sizes[order[k]], out)) {
+          ok = false;
+          break;
+        }
+      }
+      if (ok) {
+        out->vbase = map;
+        out->vlen = map_len;
+        for (int64_t k = 0; k < count; ++k) total_read += sizes[order[k]];
+        return count;
+      }
+      if (!error.empty()) return -1;
+      out->starts.clear();
+      out->ends.clear();
+    }
+    // copy path: concatenate the windows (windows hold whole frames, so
+    // the concatenation is a valid frame chunk) and stitch in place
+    size_t need = 0;
+    for (int64_t k = 0; k < count; ++k) {
+      if (!CheckWindow(order[k])) return -1;
+      need += (size_t)sizes[order[k]];
+    }
+    if (out->data.capacity() == 0) out->data = pool.TakeChunkBuf();
+    out->data.reserve(need);
+    out->data.clear();
+    for (int64_t k = 0; k < count; ++k) {
+      int64_t off = offsets[order[k]], sz = sizes[order[k]];
+      if (map) {
+        out->data.append(map + off, (size_t)sz);
+      } else {
+        size_t base = out->data.size();
+        out->data.resize(base + (size_t)sz);
+        ssize_t got = pread(fd, &out->data[base], (size_t)sz, off);
+        if (got != (ssize_t)sz) {
+          error = "indexed recordio: short read at indexed offset";
+          return -1;
+        }
+      }
+      total_read += sz;
+    }
+    try {
+      DecodeRecordIOChunkInPlace(out);
+    } catch (const EngineError& e) {
+      error = e.msg;
+      return -1;
+    }
+    return (int64_t)out->starts.size();
+  }
+};
 
 Format parse_format(const char* fmt) {
   std::string f(fmt);
@@ -2187,6 +2316,74 @@ void dtp_recio_stats(void* handle, int64_t* out) {
 
 void dtp_recio_destroy(void* handle) {
   delete static_cast<RecordIOHandle*>(handle);
+}
+
+// --------------------------- indexed recordio (shuffled random access)
+// Python owns the index/partition/shuffle (io/indexed_recordio_split.py
+// computes the per-epoch order); this plane maps the data file and
+// serves record batches as zero-copy payload spans (see
+// IndexedRecIOHandle). offsets/sizes are the part's record windows.
+void* dtp_recidx_create(const char* path, const int64_t* offsets,
+                        const int64_t* sizes, int64_t n) {
+  auto h = std::make_unique<IndexedRecIOHandle>();
+  h->fd = open(path, O_RDONLY);
+  if (h->fd < 0) {
+    g_last_error = std::string("indexed recordio: cannot open ") + path;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(h->fd, &st) != 0 || st.st_size < 0) {
+    g_last_error = std::string("indexed recordio: cannot stat ") + path;
+    return nullptr;
+  }
+  h->map_len = (size_t)st.st_size;
+  const char* no_mmap = getenv("DMLC_TPU_NO_MMAP");
+  if (!(no_mmap && no_mmap[0] == '1') && h->map_len) {
+    void* m = mmap(nullptr, h->map_len, PROT_READ, MAP_PRIVATE, h->fd, 0);
+    if (m != MAP_FAILED) h->map = static_cast<const char*>(m);
+  }
+  h->offsets.assign(offsets, offsets + n);
+  h->sizes.assign(sizes, sizes + n);
+  return h.release();
+}
+
+// Decode records order[0..count) (ids into the handle's window table).
+// Returns the number of records (>0), 0 for count==0, -1 on error; spans
+// are [starts[i], ends[i]) into *data, leased until
+// dtp_recidx_release/destroy (same contract as dtp_recio_next_batch).
+int64_t dtp_recidx_read_batch(void* handle, const int64_t* order,
+                              int64_t count, void** lease,
+                              const uint8_t** data, const int64_t** starts,
+                              const int64_t** ends) {
+  auto* h = static_cast<IndexedRecIOHandle*>(handle);
+  if (count <= 0) return 0;
+  auto batch = h->pool.Get();
+  int64_t got = h->ReadBatch(order, count, batch.get());
+  if (got < 0) {
+    g_last_error = h->error;
+    h->error.clear();
+    return -1;
+  }
+  RecBatch* raw = h->pool.Lease(std::move(batch));
+  *lease = raw;
+  *data = reinterpret_cast<const uint8_t*>(raw->bytes());
+  *starts = raw->starts.data();
+  *ends = raw->ends.data();
+  return got;
+}
+
+void dtp_recidx_release(void* handle, void* block) {
+  if (!handle || !block) return;
+  static_cast<IndexedRecIOHandle*>(handle)->pool.Release(
+      static_cast<RecBatch*>(block));
+}
+
+int64_t dtp_recidx_bytes_read(void* handle) {
+  return static_cast<IndexedRecIOHandle*>(handle)->total_read;
+}
+
+void dtp_recidx_destroy(void* handle) {
+  delete static_cast<IndexedRecIOHandle*>(handle);
 }
 
 // strtonum parity probes (tests compare against the Python golden)

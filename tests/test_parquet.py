@@ -94,8 +94,6 @@ class TestNativeInterleave:
                            label_column="label")
         native = drain(pn)
         pn.destroy()
-        import dmlc_tpu.data.parquet_parser as pp
-        monkeypatch.setattr(pp, "ParquetParser", pp.ParquetParser)
         import dmlc_tpu.native as nat
         monkeypatch.setattr(nat, "native_available", lambda: False)
         pf = Parser.create(path, 0, 1, format="parquet",
