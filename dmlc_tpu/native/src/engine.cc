@@ -915,14 +915,15 @@ bool DecodeRecordIOViews(const char* d, size_t n, RecBatch* out) {
   return true;
 }
 
-// decode a chunk of whole frames, stitching multi-frame records in
+// decode whole frames in [d, d+n), stitching multi-frame records in
 // place (reference: RecordIOChunkReader::NextRecord — escaped magics
-// re-inserted between the frames of a multi-frame record)
-void DecodeRecordIOChunkInPlace(RecBatch* out) {
-  char* d = out->data.data();
-  size_t n = out->data.size(), pos = 0;
-  out->starts.reserve(n / 64 + 1);
-  out->ends.reserve(n / 64 + 1);
+// re-inserted between the frames of a multi-frame record); spans are
+// RELATIVE to d and append to starts/ends
+void DecodeFramesInPlace(char* d, size_t n, Buf<int64_t>* starts_out,
+                         Buf<int64_t>* ends_out) {
+  size_t pos = 0;
+  starts_out->reserve(starts_out->size() + n / 64 + 1);
+  ends_out->reserve(ends_out->size() + n / 64 + 1);
   bool in_multi = false;
   int64_t rec_start = 0, cursor = 0;  // stitch state (multi-frame only)
   while (pos < n) {
@@ -945,8 +946,8 @@ void DecodeRecordIOChunkInPlace(RecBatch* out) {
       throw EngineError{"recordio: continuation frame without start"};
     switch (cflag) {
       case 0:  // whole record: a pure view, nothing moves
-        out->starts.push_back((int64_t)start);
-        out->ends.push_back((int64_t)(start + clen));
+        starts_out->push_back((int64_t)start);
+        ends_out->push_back((int64_t)(start + clen));
         break;
       case 1:  // start frame: payload already in place
         rec_start = (int64_t)start;
@@ -959,8 +960,8 @@ void DecodeRecordIOChunkInPlace(RecBatch* out) {
         std::memmove(d + cursor, d + start, clen);
         cursor += (int64_t)clen;
         if (cflag >= 3) {
-          out->starts.push_back(rec_start);
-          out->ends.push_back(cursor);
+          starts_out->push_back(rec_start);
+          ends_out->push_back(cursor);
           in_multi = false;
         }
         break;
@@ -969,6 +970,11 @@ void DecodeRecordIOChunkInPlace(RecBatch* out) {
   }
   if (in_multi)
     throw EngineError{"recordio: truncated multi-frame record"};
+}
+
+void DecodeRecordIOChunkInPlace(RecBatch* out) {
+  DecodeFramesInPlace(out->data.data(), out->data.size(),
+                      &out->starts, &out->ends);
 }
 
 // ----------------------------------------------------------- format parse
@@ -2022,22 +2028,25 @@ struct IndexedRecIOHandle {
       out->starts.clear();
       out->ends.clear();
     }
-    // copy path: concatenate the windows (windows hold whole frames, so
-    // the concatenation is a valid frame chunk) and stitch in place
+    // copy path: read each window, decode ITS frames in place, and keep
+    // only the window's FIRST record — one record per index entry, the
+    // golden's next_record contract (a sparse index can put extra
+    // records inside a window; the golden ignores them, so must we)
     size_t need = 0;
     for (int64_t k = 0; k < count; ++k) {
       if (!CheckWindow(order[k])) return -1;
       need += (size_t)sizes[order[k]];
     }
     if (out->data.capacity() == 0) out->data = pool.TakeChunkBuf();
-    out->data.reserve(need);
-    out->data.clear();
+    out->data.reserve(need);  // no reallocation below: segment pointers
+    out->data.clear();        // stay valid across the loop
+    Buf<int64_t> wstarts, wends;  // per-window scratch spans
     for (int64_t k = 0; k < count; ++k) {
       int64_t off = offsets[order[k]], sz = sizes[order[k]];
+      size_t base = out->data.size();
       if (map) {
         out->data.append(map + off, (size_t)sz);
       } else {
-        size_t base = out->data.size();
         out->data.resize(base + (size_t)sz);
         ssize_t got = pread(fd, &out->data[base], (size_t)sz, off);
         if (got != (ssize_t)sz) {
@@ -2046,12 +2055,21 @@ struct IndexedRecIOHandle {
         }
       }
       total_read += sz;
-    }
-    try {
-      DecodeRecordIOChunkInPlace(out);
-    } catch (const EngineError& e) {
-      error = e.msg;
-      return -1;
+      wstarts.clear();
+      wends.clear();
+      try {
+        DecodeFramesInPlace(&out->data[base], (size_t)sz, &wstarts,
+                            &wends);
+      } catch (const EngineError& e) {
+        error = e.msg;
+        return -1;
+      }
+      if (wstarts.empty()) {
+        error = "indexed recordio: no complete record in index window";
+        return -1;
+      }
+      out->starts.push_back((int64_t)base + wstarts[0]);
+      out->ends.push_back((int64_t)base + wends[0]);
     }
     return (int64_t)out->starts.size();
   }

@@ -1,0 +1,26 @@
+"""__graft_entry__ driver hooks: the single-chip compile check and the
+8-device dryrun (with its 1-device parity golden) must stay green — the
+round driver runs them out-of-band, so CI failing first is cheaper."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ENTRY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "__graft_entry__.py")
+
+
+@pytest.mark.slow
+def test_entry_and_dryrun_multichip():
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    proc = subprocess.run([sys.executable, ENTRY], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "entry() compile+run:" in proc.stdout
+    assert "dryrun_multichip(8)" in proc.stdout
+    # the mesh-vs-1-device parity golden must have executed
+    assert "1-device parity" in proc.stdout, proc.stdout

@@ -649,6 +649,57 @@ class TestNativeIndexedRecordIO:
         two = py_epochs(0, 1, 2)
         assert two[0] != two[1]
 
+    @pytest.mark.parametrize("no_mmap", [False, True])
+    def test_sparse_index_one_record_per_window(self, tmp_path, rng,
+                                                monkeypatch, no_mmap):
+        """An index that skips records makes windows span 2+ framed
+        records; the golden's next_record returns only the FIRST record
+        of each window, and BOTH native modes (views and copy/pread)
+        must match that — not emit the extra records."""
+        import struct
+        from dmlc_tpu.io.recordio import (RecordIOWriter, RECORDIO_MAGIC)
+        from dmlc_tpu.io.stream import create_stream
+        from dmlc_tpu.io.indexed_recordio_split import IndexedRecordIOSplit
+        from dmlc_tpu.native.bindings import NativeIndexedRecordIOReader
+        magic = struct.pack("<I", RECORDIO_MAGIC)
+        path = str(tmp_path / "sparse.rec")
+        offsets = []
+        with open(path, "wb") as fh:
+            class _Counting:
+                def __init__(self, inner):
+                    self.inner, self.written = inner, 0
+                def write(self, d):
+                    self.written += len(d)
+                    return self.inner.write(d)
+            cs = _Counting(fh)
+            w = RecordIOWriter(cs)
+            for i in range(60):
+                offsets.append(cs.written)
+                if i % 10 == 0:  # some multi-frame records too
+                    w.write_record(magic + rng.bytes(24))
+                else:
+                    w.write_record(rng.bytes(rng.randint(10, 200)))
+        # sparse index: every SECOND record only
+        with create_stream(path + ".idx", "w") as ix:
+            for k, off in enumerate(offsets[::2]):
+                ix.write(f"{k}\t{off}\n".encode())
+        if no_mmap:
+            monkeypatch.setenv("DMLC_TPU_NO_MMAP", "1")
+        sp = IndexedRecordIOSplit(path, 0, 1, shuffle=True, seed=2,
+                                  batch_size=7)
+        golden = []
+        while True:
+            r = sp.next_record()
+            if r is None:
+                break
+            golden.append(r)
+        nat = NativeIndexedRecordIOReader(path, 0, 1, shuffle=True,
+                                          seed=2, batch_size=7)
+        got = list(nat.records())
+        nat.destroy()
+        assert len(got) == len(golden) == 30
+        assert got == golden
+
     def test_indexed_shuffled_no_mmap(self, tmp_path, rng, monkeypatch):
         from dmlc_tpu.io.recordio import IndexedRecordIOWriter
         from dmlc_tpu.io.stream import create_stream
