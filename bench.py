@@ -86,10 +86,11 @@ def main() -> None:
     if hasattr(warm, "destroy"):
         warm.destroy()
 
-    # chunks sized so each device_put stays well under the tunnel's
-    # large-transfer cliff (throughput peaks near ~4-8 MB per transfer
-    # and halves by ~32 MB) while amortizing per-chunk overhead
-    chunk_mb = int(os.environ.get("DMLC_TPU_BENCH_CHUNK_MB", "8"))
+    # chunks sized so each device_put stays under the tunnel's
+    # large-transfer cliff: r3 measured the cliff is already severe at
+    # 8 MB (device_chunks ~0.2 GB/s vs 1.28 at 4 MB; bench sustained
+    # 0.40 vs 0.54 GB/s for 8 vs 4 MB chunks on the same chip)
+    chunk_mb = int(os.environ.get("DMLC_TPU_BENCH_CHUNK_MB", "4"))
     parser = Parser.create(DATA, 0, 1, format="libsvm", engine="auto",
                            chunk_size=chunk_mb << 20)
 

@@ -62,6 +62,16 @@ class Stream:
             chunks.append(b)
         return b"".join(chunks)
 
+    def readinto(self, b) -> int:
+        """Read up to len(b) bytes INTO a caller buffer; returns the
+        count (0 at EOF). The base implementation reads-then-copies;
+        file-backed streams override with a true in-place read so pooled
+        staging buffers skip the fresh-bytes allocation per chunk."""
+        data = self.read(len(b))
+        n = len(data)
+        b[:n] = data
+        return n
+
     def __enter__(self) -> "Stream":
         return self
 
@@ -145,6 +155,12 @@ class FileStream(SeekStream):
 
     def read(self, nbytes: int) -> bytes:
         return self._f.read(nbytes)
+
+    def readinto(self, b) -> int:
+        ri = getattr(self._f, "readinto", None)
+        if ri is not None:
+            return int(ri(b))
+        return super().readinto(b)
 
     def write(self, data) -> int:
         return self._f.write(data)

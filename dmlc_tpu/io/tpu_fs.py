@@ -58,6 +58,25 @@ def local_path(uri: str) -> str:
     return uri
 
 
+def _device_put_safe(v, device, plat: str, recycled: bool):
+    """device_put with the CPU-aliasing rule in ONE place: on the CPU
+    backend jax.device_put may ALIAS host memory instead of copying, so
+    any source that gets recycled/overwritten later (pooled staging
+    buffers, leased native arenas) must be copied first. Real
+    accelerator transfers always copy."""
+    import jax
+    import numpy as np
+    if recycled and plat == "cpu":
+        v = np.array(v, copy=True)
+    return jax.device_put(v, device) if device is not None else \
+        jax.device_put(v)
+
+
+def _platform(device) -> str:
+    import jax
+    return device.platform if device is not None else jax.default_backend()
+
+
 class TPUSeekStream(SeekStream):
     """SeekStream over host bytes + device-chunk staging API."""
 
@@ -100,21 +119,49 @@ class TPUSeekStream(SeekStream):
         return (jax.device_put(host, device) if device is not None
                 else jax.device_put(host))
 
-    def device_chunks(self, chunk_bytes: int = 8 << 20, lookahead: int = 2,
-                      device=None) -> Iterator:
+    def device_chunks(self, chunk_bytes: int = 4 << 20, lookahead: int = 2,
+                      device=None, pool=None) -> Iterator:
         """Iterate the stream as device-resident uint8 chunks with
-        ``lookahead`` transfers in flight (read/transfer overlap)."""
+        ``lookahead`` transfers in flight (read/transfer overlap).
+
+        Transfers stage through a ring of REUSED host buffers
+        (utils.memory.BufferPool; default the thread-local pool): each
+        chunk reads in place into a warm buffer (Stream.readinto) and
+        the buffer is recycled once its transfer has landed, instead of
+        allocating + first-touch-faulting a fresh bytes object per
+        chunk. On the CPU backend jax.device_put may alias the host
+        buffer, so the staged view is copied there (pooling pays only on
+        real accelerator transfers, which always copy).
+
+        The 4 MB default chunk matches the measured transfer sweet spot
+        on the v5e tunnel (r3: pooled 1.28 GB/s median vs 1.14 unpooled
+        at 4 MB over 5 interleaved runs; BOTH modes fall off a cliff to
+        ~0.2 GB/s at 8 MB chunks — see BASELINE.md)."""
+        import jax
+        from dmlc_tpu.utils.memory import thread_local_pool
         check(lookahead >= 1, "lookahead must be >= 1")
-        pending: List = []
+        if pool is None:
+            pool = thread_local_pool()
+        plat = _platform(device)
+        pending: List = []  # (device chunk, staging buffer to recycle)
+        eof = False
         while True:
-            while len(pending) < lookahead:
-                chunk = self.read_to_device(chunk_bytes, device)
-                if chunk is None:
+            while not eof and len(pending) < lookahead:
+                buf = pool.acquire(chunk_bytes)
+                got = self._inner.readinto(memoryview(buf)[:chunk_bytes])
+                if not got:
+                    pool.release(buf)
+                    eof = True
                     break
-                pending.append(chunk)
+                dev = _device_put_safe(buf[:got], device, plat,
+                                       recycled=True)
+                pending.append((dev, buf))
             if not pending:
                 return
-            yield pending.pop(0)
+            dev, buf = pending.pop(0)
+            jax.block_until_ready(dev)  # transfer done: buffer reusable
+            pool.release(buf)
+            yield dev
 
 
 class TPUWriteStream(Stream):
@@ -169,7 +216,7 @@ class TPUFileSystem(FileSystem):
 
 def recordio_device_batches(uri: str, part_index: int = 0,
                             num_parts: int = 1, *,
-                            chunk_size: int = 8 << 20, lookahead: int = 2,
+                            chunk_size: int = 4 << 20, lookahead: int = 2,
                             device=None) -> Iterator[dict]:
     """Sharded RecordIO ingest straight to device HBM.
 
@@ -185,21 +232,14 @@ def recordio_device_batches(uri: str, part_index: int = 0,
     uri = local_path(uri)
     check(lookahead >= 1, "lookahead must be >= 1")
 
-    plat = device.platform if device is not None else jax.default_backend()
+    plat = _platform(device)
 
     def _put(arrs, leased: bool):
-        out = {}
-        for k, v in arrs.items():
-            if leased and plat == "cpu":
-                # CPU jax.device_put may ALIAS the host buffer instead of
-                # copying; a leased native arena gets recycled on release,
-                # so an owned copy is mandatory for leased sources there.
-                # (TPU device_put is a real host->HBM transfer; the python
-                # fallback's buffers are already owned.)
-                v = np.array(v, copy=True)
-            out[k] = (jax.device_put(v, device) if device is not None
-                      else jax.device_put(v))
-        return out
+        # leased native arenas get recycled on release → the shared
+        # CPU-aliasing rule in _device_put_safe applies (the python
+        # fallback's buffers are owned, leased=False)
+        return {k: _device_put_safe(v, device, plat, recycled=leased)
+                for k, v in arrs.items()}
 
     from dmlc_tpu.native import native_available
     pending: List = []  # (device batch, lease or None)
