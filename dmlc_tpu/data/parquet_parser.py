@@ -205,6 +205,10 @@ class ParquetParser(Parser):
         return self._block
 
     def bytes_read(self) -> int:
+        """COMPRESSED on-disk bytes consumed so far — the honest GB/s
+        denominator. NOTE (r2 semantic change, see docs/CHANGES.md):
+        r1 counted decompressed in-memory table bytes; progress
+        accounting against uncompressed sizes will undershoot."""
         return self._bytes
 
 

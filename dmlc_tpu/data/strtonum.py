@@ -39,9 +39,19 @@ def parse_float64(token: bytes) -> float:
     return float(token)
 
 
+_F32_MAX = float(np.finfo(np.float32).max)
+
+
 def parse_float32(token: bytes) -> np.float32:
     """The frozen contract: nearest-double, then cast to float32."""
-    return np.float32(parse_float64(token))
+    d = parse_float64(token)
+    if -_F32_MAX <= d <= _F32_MAX:
+        return np.float32(d)
+    # overflow saturates to ±inf BY CONTRACT (strtof semantics); the
+    # errstate guard silences numpy's RuntimeWarning, entered only on
+    # this rare branch — not per token in the hot loop
+    with np.errstate(over="ignore"):
+        return np.float32(d)
 
 
 def parse_uint64(token: bytes) -> int:

@@ -115,19 +115,43 @@ class ShardedCheckpoint:
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.root, f"step-{step:08d}")
 
-    def latest_step(self) -> Optional[int]:
-        steps = []
+    def _committed(self, d: str) -> bool:
+        return os.path.exists(os.path.join(d, "COMMIT"))
+
+    def _resolve_step_dir(self, step: int) -> str:
+        """Committed directory for a step. A re-save writes into
+        ``step-N.new`` and swaps it in only once fully committed; if a
+        crash interrupted the swap, the committed ``.new`` IS the step —
+        the previously committed data is never the casualty."""
+        d = self._step_dir(step)
+        if self._committed(d):
+            return d
+        if self._committed(d + ".new"):
+            return d + ".new"
+        return d  # caller's commit check reports the right error
+
+    def _committed_steps(self) -> List[int]:
+        steps = set()
         for name in os.listdir(self.root):
-            if name.startswith("step-") and os.path.exists(
-                    os.path.join(self.root, name, "COMMIT")):
-                steps.append(int(name.split("-", 1)[1]))
-        return max(steps) if steps else None
+            if not name.startswith("step-"):
+                continue
+            base = name.split("-", 1)[1]
+            if base.endswith(".new"):
+                base = base[:-len(".new")]
+            try:
+                step = int(base)
+            except ValueError:
+                continue
+            if self._committed(self._resolve_step_dir(step)):
+                steps.add(step)
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._committed_steps()
+        return steps[-1] if steps else None
 
     def all_steps(self) -> List[int]:
-        return sorted(
-            int(n.split("-", 1)[1]) for n in os.listdir(self.root)
-            if n.startswith("step-") and
-            os.path.exists(os.path.join(self.root, n, "COMMIT")))
+        return self._committed_steps()
 
     # -- save
 
@@ -136,13 +160,20 @@ class ShardedCheckpoint:
         import jax
         pid = jax.process_index()
         leaves, _ = _flatten(tree)
-        d = self._step_dir(step)
+        final = self._step_dir(step)
+        # Re-saving a COMMITTED step (e.g. elastic restart with a smaller
+        # world) must not expose a data-loss window: the replacement is
+        # built in step-N.new and swapped in only after ITS commit, so
+        # the last committed checkpoint survives a crash at any point
+        # (restore recognizes a committed .new as the step — ADVICE r2).
+        replacing = self._committed(final)
+        d = final + ".new" if replacing else final
         existed = os.path.isdir(d)
         os.makedirs(d, exist_ok=True)
         if pid == 0 and existed:
-            # re-saving an existing step (e.g. elastic restart with a
-            # smaller world): invalidate it NOW, and drop shard files of
-            # pids outside the new world so restore cannot mix worlds
+            # stale uncommitted leftovers (torn save or torn re-save):
+            # invalidate NOW and drop shard files of pids outside the new
+            # world so restore cannot mix worlds
             commit = os.path.join(d, "COMMIT")
             if os.path.exists(commit):
                 os.remove(commit)
@@ -156,7 +187,7 @@ class ShardedCheckpoint:
                     continue
                 if owner >= world:
                     os.remove(os.path.join(d, name))
-        self._barrier()  # nobody writes until the step is invalidated
+        self._barrier()  # nobody writes until the workdir is clean
         shard_path = os.path.join(d, f"shard-{pid}.bin")
         tmp = shard_path + ".tmp"
         index_entries = []  # byte index: restore seeks straight to records
@@ -219,8 +250,28 @@ class ShardedCheckpoint:
         self._barrier()           # all shard files durable
         if pid == 0:
             open(os.path.join(d, "COMMIT"), "wb").close()
+            if d != final:
+                # swap: the fully committed .new becomes the step. The
+                # old committed data leaves only AFTER its replacement
+                # is committed; a crash between the renames leaves a
+                # committed .new, which _resolve_step_dir serves.
+                import shutil
+                trash = final + ".trash"
+                if os.path.isdir(trash):
+                    shutil.rmtree(trash)
+                os.rename(final, trash)
+                os.rename(d, final)
+                shutil.rmtree(trash)
+            else:
+                # fresh save of a step that may carry debris from an
+                # older interrupted swap (stale .new, orphaned .trash):
+                # the new commit supersedes both
+                import shutil
+                for stale in (final + ".new", final + ".trash"):
+                    if os.path.isdir(stale):
+                        shutil.rmtree(stale)
         self._barrier()           # COMMIT visible before any rank returns
-        return d
+        return final
 
     @staticmethod
     def _addressable_shards(leaf: Any):
@@ -279,7 +330,7 @@ class ShardedCheckpoint:
         if step is None:
             step = self.latest_step()
             check(step is not None, f"no committed checkpoint under {self.root}")
-        d = self._step_dir(step)
+        d = self._resolve_step_dir(step)
         check(os.path.exists(os.path.join(d, "COMMIT")),
               f"checkpoint step {step} is not committed")
         with create_stream(os.path.join(d, "meta.json"), "r") as s:

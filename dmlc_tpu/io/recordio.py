@@ -59,7 +59,7 @@ class RecordIOWriter:
 
     def __init__(self, stream: Stream):
         self._stream = stream
-        self.except_counter = 0  # number of magic collisions escaped
+        self.escaped_magic_count = 0  # number of magic collisions escaped
 
     def write_record(self, data: Union[bytes, bytearray, memoryview]) -> None:
         data = bytes(data)
@@ -82,7 +82,7 @@ class RecordIOWriter:
                 if hit != frame_start:
                     s.write(data[frame_start:hit])
                 frame_start = hit + 4
-                self.except_counter += 1
+                self.escaped_magic_count += 1
                 hit = data.find(_MAGIC_BYTES, frame_start)
             else:
                 hit = data.find(_MAGIC_BYTES, hit + 1)

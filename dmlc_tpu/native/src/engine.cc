@@ -989,6 +989,19 @@ struct ParserConfig {
   char delimiter = ',';
 };
 
+// Release-build backstop for the raw-cursor writes (ADVICE r2): the
+// per-push DTP_DCHECKs compile out of production builds, so every slice
+// ends with one cheap bounds audit. If a future change relaxes the
+// minimum-token-size invariants the reserves depend on, this turns a
+// silent heap overflow into a loud engine error at the first bad slice.
+inline void AuditCursorBounds(const CSRArena& a) {
+  if (a.index32.n > a.index32.cap || a.value.n > a.value.cap ||
+      a.label.n > a.label.cap || a.offset.n > a.offset.cap)
+    throw EngineError{
+        "internal: parse cursors overran their reserved capacity "
+        "(token-size invariant violated; please report)"};
+}
+
 // parse [b, e) of whole text records into arena; throws EngineError
 void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
   size_t bytes = (size_t)(e - b);
@@ -1175,6 +1188,7 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
   a->offset.n = (size_t)(oc - a->offset.data());
   if (!a->wide) a->index32.n = (size_t)(ic - a->index32.data());
   a->value.n = (size_t)(vc - a->value.data());
+  AuditCursorBounds(*a);
 }
 
 void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
@@ -1293,6 +1307,7 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
   a->offset.n = (size_t)(oc - a->offset.data());
   a->index32.n = (size_t)(ic - a->index32.data());  // csv never widens
   a->value.n = (size_t)(vc - a->value.data());
+  AuditCursorBounds(*a);
 }
 
 void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {

@@ -49,7 +49,7 @@ def main() -> None:
             w.write_record(rec)
     print(f"wrote {len(records)} records "
           f"({os.path.getsize(path) / 1e6:.1f} MB, "
-          f"{w.except_counter} escaped magics)")
+          f"{w.escaped_magic_count} escaped magics)")
 
     # --- raw device chunks through the tpu:// stream
     s = create_seek_stream_for_read(f"tpu://{path}")

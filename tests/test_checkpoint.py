@@ -276,6 +276,55 @@ class TestShardLocalRestore:
         np.testing.assert_array_equal(np.asarray(restored["x"]),
                                       np.asarray(tree["x"]))
 
+    def test_resave_replaces_data_and_cleans_up(self, tmp_path):
+        tree, mesh, sh = self._tree()
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        ck.save(1, tree)
+        n = np.asarray(tree["x"]).shape[0]
+        tree2 = {"x": jax.device_put(
+            jnp.arange(float(n), dtype=jnp.float32) * 3, sh)}
+        d = ck.save(1, tree2)
+        restored, _ = ck.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree2["x"]))
+        assert not os.path.isdir(d + ".new")
+        assert not os.path.isdir(d + ".trash")
+
+    def test_resave_crash_never_loses_committed(self, tmp_path):
+        # ADVICE r2: a torn re-save (crash while writing the replacement)
+        # must leave the previously committed step fully restorable —
+        # the replacement builds in step-N.new and only swaps in once
+        # committed
+        tree, mesh, sh = self._tree()
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        d = ck.save(1, tree)
+        new = d + ".new"
+        os.makedirs(new)
+        with open(os.path.join(new, "shard-0.bin"), "wb") as f:
+            f.write(b"torn re-save garbage")  # no COMMIT: crashed mid-write
+        assert ck.latest_step() == 1
+        restored, _ = ck.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+        # and a subsequent re-save recovers cleanly over the torn .new
+        ck.save(1, tree)
+        restored, _ = ck.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+
+    def test_interrupted_swap_serves_committed_new(self, tmp_path):
+        # crash BETWEEN the swap's two renames: step dir missing, .new
+        # fully committed — discovery and restore must serve the .new
+        tree, mesh, sh = self._tree()
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        d = ck.save(1, tree)
+        os.rename(d, d + ".new")  # exactly the mid-swap on-disk state
+        assert ck.latest_step() == 1
+        assert ck.all_steps() == [1]
+        restored, _ = ck.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+
     def test_replicated_target_restores(self, tmp_path):
         tree, mesh, _ = self._tree()
         ck = ShardedCheckpoint(str(tmp_path / "r"))

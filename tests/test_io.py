@@ -168,21 +168,21 @@ class TestRecordIO:
         # aligned magic in payload must be escaped (frame split)
         payload = b"abcd" + MAGIC_BYTES + b"efgh"
         w = self.roundtrip([payload])
-        assert w.except_counter == 1
+        assert w.escaped_magic_count == 1
 
     def test_payload_magic_at_start(self):
         w = self.roundtrip([MAGIC_BYTES + b"tail"])
-        assert w.except_counter == 1
+        assert w.escaped_magic_count == 1
 
     def test_payload_magic_unaligned_not_escaped(self):
         payload = b"ab" + MAGIC_BYTES + b"cd"  # magic at offset 2: unaligned
         w = self.roundtrip([payload])
-        assert w.except_counter == 0
+        assert w.escaped_magic_count == 0
 
     def test_payload_many_magics(self):
         payload = MAGIC_BYTES * 5
         w = self.roundtrip([payload])
-        assert w.except_counter == 5
+        assert w.escaped_magic_count == 5
 
     def test_adversarial_random(self, rng):
         records = []
