@@ -1,0 +1,59 @@
+"""Worker for bench_suite config 7 (multi-process ingest throughput).
+
+Run under parallel.launch_local as a REAL 2-process jax.distributed
+gang: each process joins the rendezvous, streams its device-granular
+shards of a criteo-shaped libsvm file through ShardedRowBlockIter for
+three epochs, and writes per-epoch wall times. Epoch 1 carries the
+one-time round-count agreement (a done-flag allgather per round);
+epochs 2+ must run collective-free (VERDICT r2 #3) — the reported
+cadence ratio is the evidence that batch cadence is independent of
+round count.
+
+Usage: bench_mp_worker.py <data_uri> <out_dir>
+"""
+
+import json
+import os
+import sys
+import time
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon TPU plugin overrides the env var; the config update is
+    # authoritative (same dance as tests/conftest.py)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    data_uri, out_dir = sys.argv[1], sys.argv[2]
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from dmlc_tpu.parallel.launch import init_from_env, finalize
+    from dmlc_tpu.parallel.sharded import ShardedRowBlockIter
+
+    pid, nprocs = init_from_env()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    it = ShardedRowBlockIter(data_uri, mesh, format="libsvm",
+                             row_bucket=1 << 11, nnz_bucket=1 << 16,
+                             chunk_size=4 << 20)
+    epoch_walls = []
+    nbatches = 0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        n = 0
+        for batch in it:
+            jax.block_until_ready(batch["value"])
+            n += 1
+        epoch_walls.append(time.perf_counter() - t0)
+        nbatches = n
+    with open(os.path.join(out_dir, f"bench-mp-{pid}.json"), "w") as f:
+        json.dump({"rank": pid, "world": nprocs, "batches": nbatches,
+                   "epoch_walls": epoch_walls}, f)
+    finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
