@@ -63,9 +63,11 @@ std::string make_csv(int rows, int cols) {
   return out;
 }
 
-std::string make_recordio(int records) {
+std::string make_recordio(int records,
+                          std::vector<int64_t>* frame_offsets = nullptr) {
   std::string out;
   for (int i = 0; i < records; ++i) {
+    if (frame_offsets) frame_offsets->push_back((int64_t)out.size());
     size_t len = g_rng() % 300;
     std::string payload;
     for (size_t k = 0; k < len; ++k)
@@ -78,6 +80,7 @@ std::string make_recordio(int records) {
     out += payload;
     out.append((4 - (payload.size() & 3)) & 3, '\0');
   }
+  if (frame_offsets) frame_offsets->push_back((int64_t)out.size());
   return out;
 }
 
@@ -147,6 +150,76 @@ int fuzz_recordio(const std::string& base, int iters) {
   return threw;
 }
 
+// Indexed random-access reads over corrupted data AND corrupted index
+// windows: offsets/sizes are themselves attacker-controlled (a hostile
+// .idx file), so CheckWindow/ViewOne/decode must reject without OOB.
+int fuzz_recidx(const std::string& base,
+                const std::vector<int64_t>& frames, int iters) {
+  int threw = 0;
+  char tmpl[] = "/tmp/dtp_fuzz_recidx_XXXXXX";
+  int tfd = mkstemp(tmpl);
+  if (tfd < 0) return -1;
+  for (int i = 0; i < iters; ++i) {
+    std::string data = base;
+    // half the iterations keep the data VALID so the accept paths (mmap
+    // views + span touching) execute, not just the reject paths; the
+    // mutated half plus hostile windows covers rejection
+    bool valid_half = (i % 2 == 0);
+    if (!valid_half)
+      for (int m = (int)(g_rng() % 6); m >= 0; --m) mutate(&data);
+    if (ftruncate(tfd, 0) != 0 ||
+        pwrite(tfd, data.data(), data.size(), 0) != (ssize_t)data.size())
+      return -1;
+    std::vector<int64_t> offs, szs;
+    if (valid_half) {
+      // true frame windows (consecutive frame offsets), a few of them
+      // spanning 2+ records (sparse-index shape)
+      for (int w = 0; w < 8; ++w) {
+        size_t a = g_rng() % (frames.size() - 1);
+        size_t b = std::min(frames.size() - 1,
+                            a + 1 + (size_t)(g_rng() % 3));
+        offs.push_back(frames[a]);
+        szs.push_back(frames[b] - frames[a]);
+      }
+    } else {
+      // hostile windows: past EOF, negative-ish sizes, zero
+      for (int w = 0; w < 8; ++w) {
+        offs.push_back((int64_t)(g_rng() % (data.size() + 64)));
+        szs.push_back((int64_t)(g_rng() % 512) - 8);
+      }
+    }
+    void* h = dtp_recidx_create(tmpl, offs.data(), szs.data(),
+                                (int64_t)offs.size());
+    if (!h) continue;
+    std::vector<int64_t> order;
+    for (int k = 0; k < 8; ++k)
+      order.push_back(valid_half
+                          ? (int64_t)(g_rng() % offs.size())
+                          : (int64_t)(g_rng() % 12) - 2);  // incl. bad ids
+    void* lease = nullptr;
+    const uint8_t* d = nullptr;
+    const int64_t* st = nullptr;
+    const int64_t* en = nullptr;
+    int64_t got = dtp_recidx_read_batch(h, order.data(),
+                                        (int64_t)order.size(), &lease,
+                                        &d, &st, &en);
+    if (got < 0) {
+      ++threw;  // rejection is fine; OOB is not (ASAN checks)
+    } else if (got > 0) {
+      // touch every span byte: views must be in bounds
+      uint64_t sum = 0;
+      for (int64_t r = 0; r < got; ++r)
+        for (int64_t p = st[r]; p < en[r]; ++p) sum += d[p];
+      (void)sum;
+      dtp_recidx_release(h, lease);
+    }
+    dtp_recidx_destroy(h);
+  }
+  close(tfd);
+  unlink(tmpl);
+  return threw;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -154,15 +227,18 @@ int main(int argc, char** argv) {
   std::string svm = make_libsvm(60);
   std::string fm = make_libfm(60);
   std::string csv = make_csv(40, 8);
-  std::string rec = make_recordio(40);
+  std::vector<int64_t> frames;
+  std::string rec = make_recordio(40, &frames);
   int t1 = fuzz_text(Format::kLibSVM, svm, iters);
   int t2 = fuzz_text(Format::kCSV, csv, iters);
   int t3 = fuzz_text(Format::kLibFM, fm, iters);
   int t4 = fuzz_recordio(rec, iters);
+  int t5 = fuzz_recidx(rec, frames, iters);
   // sanity: the corrupting fuzz must actually hit rejection paths
   std::printf("fuzz complete: rejects libsvm=%d csv=%d libfm=%d "
-              "recordio=%d of %d each\n", t1, t2, t3, t4, iters);
-  if (t1 == 0 || t2 == 0 || t3 == 0 || t4 == 0) {
+              "recordio=%d recidx=%d of %d each\n", t1, t2, t3, t4, t5,
+              iters);
+  if (t1 == 0 || t2 == 0 || t3 == 0 || t4 == 0 || t5 <= 0) {
     std::fprintf(stderr, "fuzz too weak: no rejections seen\n");
     return 1;
   }
