@@ -104,6 +104,44 @@ class TestSoak:
         parser.destroy()
         assert grown < 128, f"RSS grew {grown:.0f} MB with lease cycling"
 
+    def test_indexed_shuffled_soak(self, tmp_path):
+        """Shuffled random-access reads across many epochs: the shared
+        RecBatchPool and the single long-lived mapping must keep RSS
+        flat (every epoch touches the whole file in a fresh order)."""
+        from dmlc_tpu.io.recordio import IndexedRecordIOWriter
+        from dmlc_tpu.io.stream import create_stream
+        from dmlc_tpu.native.bindings import NativeIndexedRecordIOReader
+        rng = np.random.RandomState(5)
+        path = str(tmp_path / "soak_idx.rec")
+        with create_stream(path, "w") as s, \
+                create_stream(path + ".idx", "w") as ix:
+            w = IndexedRecordIOWriter(s, ix)
+            written = 0
+            while written < (96 << 20):
+                rec = rng.bytes(rng.randint(50_000, 150_000))
+                w.write_record(rec)
+                written += len(rec) + 8
+        reader = NativeIndexedRecordIOReader(path, 0, 1, shuffle=True,
+                                             seed=9, batch_size=32)
+
+        def epoch(first: bool) -> int:
+            if not first:
+                reader.before_first()  # next epoch's permutation
+            n = 0
+            while True:
+                batch = reader.next_batch()
+                if batch is None:
+                    return n
+                n += len(batch[1])
+
+        n0 = epoch(True)
+        warm = _rss_mb()
+        for _ in range(3):
+            assert epoch(False) == n0
+        grown = _rss_mb() - warm
+        reader.destroy()
+        assert grown < 64, f"RSS grew {grown:.0f} MB across shuffled epochs"
+
     def test_recordio_soak(self, tmp_path):
         from dmlc_tpu.io.recordio import RecordIOWriter
         from dmlc_tpu.native.bindings import NativeRecordIOReader
