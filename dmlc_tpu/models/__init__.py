@@ -5,9 +5,11 @@ exist to close the TPU loop — prove that HBM-resident CSR batches train a
 real learner end-to-end under jit/shard_map. SparseLinearModel is the
 flagship: the logistic-regression core of the linear XGBoost booster
 family, consuming exactly the sharded batch layout dmlc_tpu.parallel
-produces.
+produces. SparseFMModel (second-order factorization machine) is the
+canonical consumer of the libfm format family.
 """
 
+from dmlc_tpu.models.fm import SparseFMModel
 from dmlc_tpu.models.linear import SparseLinearModel
 
-__all__ = ["SparseLinearModel"]
+__all__ = ["SparseLinearModel", "SparseFMModel"]

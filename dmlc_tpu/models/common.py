@@ -1,0 +1,20 @@
+"""Shared model math: one definition per formula, used by every model
+and by both the single-chip and shard_map paths (so the two can never
+silently diverge)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["stable_bce_on_logits"]
+
+
+def stable_bce_on_logits(margins: jnp.ndarray,
+                         labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-row binary cross-entropy on logits, numerically stable.
+
+    Labels may follow the ±1 (libsvm) or {0,1} convention: y = label > 0.
+    """
+    y = (labels > 0).astype(jnp.float32)
+    return (jnp.maximum(margins, 0) - margins * y +
+            jnp.log1p(jnp.exp(-jnp.abs(margins))))

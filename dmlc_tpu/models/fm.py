@@ -1,0 +1,137 @@
+"""Factorization machine (second-order) over sparse CSR batches.
+
+The canonical consumer of the libfm format family (reference:
+src/data/libfm_parser.h parses it; dmlc-core itself ships no models).
+Same layout contracts as models.linear: flat padded CSR single-chip,
+global [D, ...] batches under shard_map multi-chip, padded rows weight-0
+and therefore loss/gradient-neutral.
+
+Math (Rendle 2010, the O(nnz·K) identity):
+    ŷ(x) = b + Σ_i w_i x_i + ½ Σ_f [ (Σ_i v_{i,f} x_i)² − Σ_i v_{i,f}² x_i² ]
+Both inner sums are per-row segment sums over the CSR nonzeros, so the
+whole forward is two gathers + two segment-sums + elementwise — XLA
+fuses it onto the VPU; no dynamic shapes. (Field-AWARE factorization —
+FFM, using the libfm field[] column — is the upgrade path; plain FM
+ignores fields by definition.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.models.common import stable_bce_on_logits
+from dmlc_tpu.ops.csr import csr_row_ids, segment_spmv
+
+__all__ = ["SparseFMModel"]
+
+
+def _fm_margins(w, b, V, offset, index, value, num_rows: int):
+    """Per-row FM margins for one flat CSR block — the ONE definition of
+    the model equation, shared by the single-chip and shard_map paths."""
+    linear = segment_spmv(offset, index, value, w, num_rows=num_rows)
+    rows = csr_row_ids(offset, index.shape[0]).astype(jnp.int32)
+    vx = value[:, None] * jnp.take(V, index.astype(jnp.int32), axis=0)
+    s = jax.ops.segment_sum(vx, rows, num_segments=num_rows)
+    sq = jax.ops.segment_sum(vx * vx, rows, num_segments=num_rows)
+    return linear + 0.5 * jnp.sum(s * s - sq, axis=-1) + b
+
+
+class SparseFMModel:
+    """Second-order FM with logistic loss (labels ±1 or {0,1})."""
+
+    def __init__(self, num_features: int, num_factors: int = 8,
+                 l2: float = 0.0, learning_rate: float = 0.1,
+                 init_scale: float = 0.01):
+        self.num_features = num_features
+        self.num_factors = num_factors
+        self.l2 = l2
+        self.learning_rate = learning_rate
+        self.init_scale = init_scale
+
+    def init_params(self, seed: int = 0) -> Dict[str, jnp.ndarray]:
+        key = jax.random.PRNGKey(seed)
+        return {
+            "w": jnp.zeros((self.num_features,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32),
+            # small random factors: an all-zero V has zero gradient
+            # through the s²−sq term (saddle), so zero init cannot learn
+            "V": self.init_scale * jax.random.normal(
+                key, (self.num_features, self.num_factors), jnp.float32),
+        }
+
+    # -- single-chip path (flat padded batch)
+
+    def forward(self, params: Dict[str, Any],
+                batch: Dict[str, Any]) -> jnp.ndarray:
+        return _fm_margins(params["w"], params["b"], params["V"],
+                           batch["offset"], batch["index"], batch["value"],
+                           num_rows=batch["label"].shape[0])
+
+    def loss(self, params: Dict[str, Any],
+             batch: Dict[str, Any]) -> jnp.ndarray:
+        per_row = stable_bce_on_logits(self.forward(params, batch),
+                                       batch["label"])
+        w = batch["weight"]
+        loss = jnp.sum(per_row * w) / jnp.maximum(jnp.sum(w), 1.0)
+        if self.l2:
+            loss = loss + self.l2 * (jnp.sum(params["w"] ** 2) +
+                                     jnp.sum(params["V"] ** 2))
+        return loss
+
+    @partial(jax.jit, static_argnums=0)
+    def train_step(self, params, batch):
+        loss, grads = jax.value_and_grad(self.loss)(params, batch)
+        new_params = jax.tree.map(
+            lambda p, g: p - self.learning_rate * g, params, grads)
+        return new_params, loss
+
+    # -- multi-chip path (global [D, ...] batches, shard_map over 'data')
+
+    def global_loss_fn(self, mesh: Mesh, axis: str = "data"):
+        def _block_loss(w, b, V, offset, index, value, label, weight):
+            row_bucket = label.shape[1]
+            margins = _fm_margins(w, b, V, offset[0], index[0], value[0],
+                                  num_rows=row_bucket)
+            per_row = stable_bce_on_logits(margins, label[0])
+            lsum = jax.lax.psum(jnp.sum(per_row * weight[0]), axis)
+            wsum = jax.lax.psum(jnp.sum(weight[0]), axis)
+            return lsum / jnp.maximum(wsum, 1.0)
+
+        from jax import shard_map
+        smapped = shard_map(
+            _block_loss, mesh=mesh,
+            in_specs=(P(), P(), P(), P(axis), P(axis), P(axis), P(axis),
+                      P(axis)),
+            out_specs=P())
+
+        def loss(params, batch):
+            base = smapped(params["w"], params["b"], params["V"],
+                           batch["offset"], batch["index"], batch["value"],
+                           batch["label"], batch["weight"])
+            if self.l2:
+                base = base + self.l2 * (jnp.sum(params["w"] ** 2) +
+                                         jnp.sum(params["V"] ** 2))
+            return base
+        return loss
+
+    def make_sharded_train_step(self, mesh: Mesh, axis: str = "data"):
+        loss_fn = self.global_loss_fn(mesh, axis)
+        replicated = NamedSharding(mesh, P())
+
+        @partial(jax.jit, out_shardings=(replicated, replicated))
+        def step(params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_params = jax.tree.map(
+                lambda p, g: p - self.learning_rate * g, params, grads)
+            return new_params, loss
+        return step
+
+    # -- inference
+
+    def predict_proba(self, params, batch) -> jnp.ndarray:
+        return jax.nn.sigmoid(self.forward(params, batch))

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_tpu.models.common import stable_bce_on_logits
 from dmlc_tpu.ops.csr import segment_spmv
 
 __all__ = ["SparseLinearModel"]
@@ -60,11 +61,8 @@ class SparseLinearModel:
     def loss(self, params: Dict[str, Any],
              batch: Dict[str, Any]) -> jnp.ndarray:
         """Weighted BCE over real rows (padded rows have weight 0)."""
-        margins = self.forward(params, batch)
-        y = (batch["label"] > 0).astype(jnp.float32)
-        # numerically stable BCE on logits
-        per_row = jnp.maximum(margins, 0) - margins * y + jnp.log1p(
-            jnp.exp(-jnp.abs(margins)))
+        per_row = stable_bce_on_logits(self.forward(params, batch),
+                                       batch["label"])
         w = batch["weight"]
         loss = jnp.sum(per_row * w) / jnp.maximum(jnp.sum(w), 1.0)
         if self.l2:
@@ -87,9 +85,7 @@ class SparseLinearModel:
             row_bucket = label.shape[1]
             margins = segment_spmv(offset[0], index[0], value[0], w,
                                    num_rows=row_bucket) + b
-            y = (label[0] > 0).astype(jnp.float32)
-            per_row = (jnp.maximum(margins, 0) - margins * y +
-                       jnp.log1p(jnp.exp(-jnp.abs(margins))))
+            per_row = stable_bce_on_logits(margins, label[0])
             lsum = jax.lax.psum(jnp.sum(per_row * weight[0]), axis)
             wsum = jax.lax.psum(jnp.sum(weight[0]), axis)
             return lsum / jnp.maximum(wsum, 1.0)
