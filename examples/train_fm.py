@@ -1,0 +1,89 @@
+"""End-to-end example: libfm ingest -> factorization-machine training.
+
+The libfm format family closed into a loop: LibFMParser (reference:
+src/data/libfm_parser.h) parses field:index:value text, and
+SparseFMModel — the second-order FM that format family exists to feed —
+trains on the resulting CSR batches under shard_map. The training data
+follows a pure INTERACTION rule (label = XOR over feature pairs), which
+a linear model provably cannot fit and the FM's pairwise term can.
+
+Runs anywhere: on a CPU-only host it uses 8 virtual devices.
+"""
+
+import os
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+else:
+    try:
+        jax.devices()
+    except RuntimeError:  # preset platform unavailable -> CPU fallback
+        jax.config.update("jax_platforms", "cpu")
+
+from dmlc_tpu.models import SparseFMModel  # noqa: E402
+from dmlc_tpu.parallel import ShardedRowBlockIter  # noqa: E402
+from dmlc_tpu.io.tempdir import TemporaryDirectory  # noqa: E402
+
+NPAIRS = 4
+NCOL = 2 * NPAIRS + 2   # pair features + 2 context features
+ROWS = 320
+EPOCHS = 60
+
+
+def make_libfm(path: str) -> None:
+    """label = XOR(which side of a pair fired, context bit): zero linear
+    signal by construction."""
+    rng = np.random.RandomState(0)
+    with open(path, "w") as f:
+        for _ in range(ROWS):
+            a, b, cbit = rng.randint(NPAIRS), rng.randint(2), rng.randint(2)
+            feats = sorted({2 * a + b, 2 * NPAIRS + cbit})
+            y = 1 if b == cbit else 0
+            # field:index:value — field 0 = pair features, 1 = context
+            # (plain FM ignores fields; an FFM extension would use them)
+            toks = " ".join(
+                f"{0 if j < 2 * NPAIRS else 1}:{j}:1" for j in feats)
+            f.write(f"{y} {toks}\n")
+
+
+def main() -> None:
+    with TemporaryDirectory() as tmp:
+        data = os.path.join(tmp.path, "train.libfm")
+        make_libfm(data)
+
+        mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+        print(f"mesh: {mesh.devices.size} devices on "
+              f"{jax.devices()[0].platform}")
+
+        it = ShardedRowBlockIter(data, mesh, format="libfm",
+                                 row_bucket=64, nnz_bucket=256)
+        batches = list(it)
+        model = SparseFMModel(NCOL, num_factors=4, learning_rate=1.0)
+        params = jax.device_put(model.init_params(seed=2))
+        step = model.make_sharded_train_step(mesh)
+
+        _, loss0 = step(params, batches[0])
+        for epoch in range(EPOCHS):
+            for batch in batches:
+                params, loss = step(params, batch)
+            if (epoch + 1) % 20 == 0:
+                print(f"epoch {epoch + 1}: loss {float(loss):.4f}")
+        _, loss1 = step(params, batches[0])
+        print(f"loss {float(loss0):.4f} -> {float(loss1):.4f} "
+              f"(pure-interaction rule: a linear model stays ~0.69)")
+        assert float(loss1) < 0.3, "FM failed to learn the XOR rule"
+        print("OK")
+
+
+if __name__ == "__main__":
+    main()

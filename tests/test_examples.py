@@ -37,6 +37,11 @@ class TestExamples:
         r = _run("train_sparse_linear.py")
         assert r.returncode == 0, r.stderr[-3000:]
 
+    def test_train_fm(self):
+        r = _run("train_fm.py")
+        assert r.returncode == 0, r.stderr[-3000:]
+        assert "OK" in r.stdout
+
     def test_tpu_device_ingest(self):
         r = _run("tpu_device_ingest.py")
         assert r.returncode == 0, r.stderr[-3000:]

@@ -790,19 +790,36 @@ class TestCppUnittests:
     """Build and run the native C++ unit-test program (reference:
     test/unittest gtest suite; see engine_unittest.cc)."""
 
-    def test_cpp_unittests(self, tmp_path):
+    @staticmethod
+    def _build_and_run(tmp_path, source_name, argv=()):
+        """Build a native test/tool program against engine.cc and run it
+        (shared by the unittest and microbench smoke)."""
         from dmlc_tpu import native as native_pkg
         src = os.path.join(os.path.dirname(native_pkg.__file__),
-                           "src", "engine_unittest.cc")
-        exe = str(tmp_path / "engine_unittest")
+                           "src", source_name)
+        exe = str(tmp_path / source_name.replace(".cc", ""))
         build = subprocess.run(
             ["g++"] + _gcc_flags() + [src, "-o", exe],
             capture_output=True, text=True, timeout=300)
         assert build.returncode == 0, build.stderr[-2000:]
-        run = subprocess.run([exe], capture_output=True, text=True,
+        run = subprocess.run([exe, *argv], capture_output=True, text=True,
                              timeout=300)
         assert run.returncode == 0, (run.stdout + run.stderr)[-2000:]
+        return run
+
+    def test_cpp_unittests(self, tmp_path):
+        run = self._build_and_run(tmp_path, "engine_unittest.cc")
         assert "all native unit tests passed" in run.stdout
+
+    def test_microbench_smoke(self, tmp_path):
+        """The kernel A/B harness (engine_microbench.cc) must keep
+        compiling and producing sane numbers+digests — it is the tool
+        perf work leans on, so CI smoke-builds it at 1 iter / 2 MB."""
+        run = self._build_and_run(tmp_path, "engine_microbench.cc",
+                                  argv=("1", "2"))
+        for name in ("libsvm/a1a", "libsvm/criteo", "csv/higgs"):
+            assert name in run.stdout, run.stdout
+        assert "GB/s" in run.stdout and "digest=" in run.stdout
 
 
 @pytest.mark.skipif(not _have_gxx, reason="g++ not available")
