@@ -119,7 +119,7 @@ class ShardedCheckpoint:
         return os.path.exists(os.path.join(d, "COMMIT"))
 
     def _resolve_step_dir(self, step: int) -> str:
-        """Committed directory for a step. A re-save writes into
+        """Committed directory for a step. Every save writes into
         ``step-N.new`` and swaps it in only once fully committed; if a
         crash interrupted the swap, the committed ``.new`` IS the step —
         the previously committed data is never the casualty."""
@@ -161,13 +161,24 @@ class ShardedCheckpoint:
         pid = jax.process_index()
         leaves, _ = _flatten(tree)
         final = self._step_dir(step)
-        # Re-saving a COMMITTED step (e.g. elastic restart with a smaller
-        # world) must not expose a data-loss window: the replacement is
-        # built in step-N.new and swapped in only after ITS commit, so
-        # the last committed checkpoint survives a crash at any point
-        # (restore recognizes a committed .new as the step — ADVICE r2).
-        replacing = self._committed(final)
-        d = final + ".new" if replacing else final
+        # Every save builds in step-N.new and swaps it in only after ITS
+        # commit. Unconditionally: the target must not depend on local
+        # filesystem state (is step-N committed?), because on a shared FS
+        # with attribute/negative-dentry caching (NFS) ranks can disagree
+        # on that answer and scatter their shards across two directories
+        # (ADVICE r3). A state-independent choice needs no agreement. The
+        # swap also keeps re-saves crash-safe: the last committed
+        # checkpoint survives a crash at any point, and restore
+        # recognizes a committed .new as the step (ADVICE r2).
+        d = final + ".new"
+        # A crash between the commit-time renames can leave the step's
+        # ONLY committed copy in step-N.new (final absent or stale).
+        # Finish that swap before touching .new — otherwise the cleanup
+        # below would strip COMMIT from the only committed copy and a
+        # second crash during this save would lose the checkpoint.
+        if pid == 0 and self._committed(d):
+            self._swap_in(final)
+        self._barrier()  # .new is settled before anyone creates into it
         existed = os.path.isdir(d)
         os.makedirs(d, exist_ok=True)
         if pid == 0 and existed:
@@ -250,28 +261,30 @@ class ShardedCheckpoint:
         self._barrier()           # all shard files durable
         if pid == 0:
             open(os.path.join(d, "COMMIT"), "wb").close()
-            if d != final:
-                # swap: the fully committed .new becomes the step. The
-                # old committed data leaves only AFTER its replacement
-                # is committed; a crash between the renames leaves a
-                # committed .new, which _resolve_step_dir serves.
-                import shutil
-                trash = final + ".trash"
-                if os.path.isdir(trash):
-                    shutil.rmtree(trash)
-                os.rename(final, trash)
-                os.rename(d, final)
-                shutil.rmtree(trash)
-            else:
-                # fresh save of a step that may carry debris from an
-                # older interrupted swap (stale .new, orphaned .trash):
-                # the new commit supersedes both
-                import shutil
-                for stale in (final + ".new", final + ".trash"):
-                    if os.path.isdir(stale):
-                        shutil.rmtree(stale)
+            self._swap_in(final)
         self._barrier()           # COMMIT visible before any rank returns
         return final
+
+    @staticmethod
+    def _swap_in(final: str) -> None:
+        """Make a fully committed ``final + ".new"`` become ``final``.
+
+        Any old committed data leaves only AFTER its replacement is
+        committed: a crash between the renames leaves a committed .new,
+        which ``_resolve_step_dir`` serves and the NEXT ``save`` finishes
+        swapping before it reuses .new. An orphaned .trash (crash after
+        the second rename) is swept by the next swap.
+        """
+        import shutil
+        d = final + ".new"
+        trash = final + ".trash"
+        if os.path.isdir(trash):
+            shutil.rmtree(trash)
+        if os.path.isdir(final):
+            os.rename(final, trash)
+        os.rename(d, final)
+        if os.path.isdir(trash):
+            shutil.rmtree(trash)
 
     @staticmethod
     def _addressable_shards(leaf: Any):

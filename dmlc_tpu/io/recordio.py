@@ -61,6 +61,14 @@ class RecordIOWriter:
         self._stream = stream
         self.escaped_magic_count = 0  # number of magic collisions escaped
 
+    @property
+    def except_counter(self) -> int:
+        """Deprecated alias for ``escaped_magic_count`` — the reference
+        RecordIOWriter's name (src/recordio.cc ``except_counter()``),
+        kept so consumers following the README parity table keep
+        working. See docs/CHANGES.md (round 3 rename)."""
+        return self.escaped_magic_count
+
     def write_record(self, data: Union[bytes, bytearray, memoryview]) -> None:
         data = bytes(data)
         size = len(data)

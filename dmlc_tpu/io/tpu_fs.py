@@ -109,6 +109,13 @@ class TPUSeekStream(SeekStream):
         Returns a uint8 jax.Array (async transfer — not blocked on), or
         None at EOF. The transfer is enqueued immediately; callers that
         need completion use jax.block_until_ready.
+
+        Unlike ``device_chunks`` this path does NOT stage through the
+        BufferPool: the staging buffer's lifetime escapes the call (the
+        async transfer may still be reading it when we return), and this
+        one-shot API has no later point at which to observe completion
+        and recycle — ``device_chunks`` can pool only because its loop
+        sees each transfer land before releasing the buffer.
         """
         import jax
         import numpy as np
@@ -145,23 +152,34 @@ class TPUSeekStream(SeekStream):
         plat = _platform(device)
         pending: List = []  # (device chunk, staging buffer to recycle)
         eof = False
-        while True:
-            while not eof and len(pending) < lookahead:
-                buf = pool.acquire(chunk_bytes)
-                got = self._inner.readinto(memoryview(buf)[:chunk_bytes])
-                if not got:
-                    pool.release(buf)
-                    eof = True
-                    break
-                dev = _device_put_safe(buf[:got], device, plat,
-                                       recycled=True)
-                pending.append((dev, buf))
-            if not pending:
-                return
-            dev, buf = pending.pop(0)
-            jax.block_until_ready(dev)  # transfer done: buffer reusable
-            pool.release(buf)
-            yield dev
+        try:
+            while True:
+                while not eof and len(pending) < lookahead:
+                    buf = pool.acquire(chunk_bytes)
+                    got = self._inner.readinto(
+                        memoryview(buf)[:chunk_bytes])
+                    if not got:
+                        pool.release(buf)
+                        eof = True
+                        break
+                    dev = _device_put_safe(buf[:got], device, plat,
+                                           recycled=True)
+                    pending.append((dev, buf))
+                if not pending:
+                    return
+                dev, buf = pending.pop(0)
+                jax.block_until_ready(dev)  # transfer done: buf reusable
+                pool.release(buf)
+                yield dev
+        finally:
+            # consumer abandoned the generator (break/close/GC) with
+            # transfers still in flight: drain them before releasing the
+            # staging buffers, or the pool could hand a buffer that an
+            # async device_put is still reading to the next reader
+            # (ADVICE r3)
+            for dev, buf in pending:
+                jax.block_until_ready(dev)
+                pool.release(buf)
 
 
 class TPUWriteStream(Stream):

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.models.common import stable_bce_on_logits
-from dmlc_tpu.ops.csr import csr_row_ids, segment_spmv
+from dmlc_tpu.ops.csr import csr_row_ids, segment_spmv, segment_sum
 
 __all__ = ["SparseFMModel"]
 
@@ -36,8 +36,8 @@ def _fm_margins(w, b, V, offset, index, value, num_rows: int):
     linear = segment_spmv(offset, index, value, w, num_rows=num_rows)
     rows = csr_row_ids(offset, index.shape[0]).astype(jnp.int32)
     vx = value[:, None] * jnp.take(V, index.astype(jnp.int32), axis=0)
-    s = jax.ops.segment_sum(vx, rows, num_segments=num_rows)
-    sq = jax.ops.segment_sum(vx * vx, rows, num_segments=num_rows)
+    s = segment_sum(vx, rows, num_segments=num_rows)
+    sq = segment_sum(vx * vx, rows, num_segments=num_rows)
     return linear + 0.5 * jnp.sum(s * s - sq, axis=-1) + b
 
 

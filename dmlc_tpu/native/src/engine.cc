@@ -1002,6 +1002,28 @@ inline void AuditCursorBounds(const CSRArena& a) {
         "(token-size invariant violated; please report)"};
 }
 
+// Always-on row-granularity bounds check (ADVICE r3): the slice-end
+// audit above detects an overrun only POST-HOC — in release builds the
+// out-of-bounds writes have already happened by then. Four predictable
+// never-taken compares per ROW (noise next to the row's parse work)
+// shrink that window: lc/oc are checked BEFORE their write, so those
+// cursors can never corrupt; ic/vc are checked after the row's token
+// writes, so a violated token-size invariant is caught at most one row
+// deep instead of a whole slice later. (Per-TOKEN ic/vc checks stay
+// debug-only — that is the hot loop the raw cursors exist to keep
+// branch-free.)
+inline void CheckRowCursors(const CSRArena& a, const uint32_t* ic,
+                            const float* vc, const float* lc,
+                            const int64_t* oc) {
+  if (lc >= a.label.data() + a.label.cap ||
+      oc >= a.offset.data() + a.offset.cap ||
+      ic > a.index32.data() + a.index32.cap ||
+      vc > a.value.data() + a.value.cap)
+    throw EngineError{
+        "internal: parse cursors overran their reserved capacity "
+        "(token-size invariant violated; please report)"};
+}
+
 // parse [b, e) of whole text records into arena; throws EngineError
 void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
   size_t bytes = (size_t)(e - b);
@@ -1177,8 +1199,7 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
       q = s;
     }
     p = q;
-    DTP_DCHECK(lc < a->label.data() + a->label.cap);
-    DTP_DCHECK(oc < a->offset.data() + a->offset.cap);
+    CheckRowCursors(*a, ic, vc, lc, oc);
     *lc++ = label;
     off += (int64_t)row_nnz;
     *oc++ = off;
@@ -1293,8 +1314,7 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
       a->min_index = 0;
       a->max_index = std::max(a->max_index, (uint64_t)(fidx - 1));
     }
-    DTP_DCHECK(lc < a->label.data() + a->label.cap);
-    DTP_DCHECK(oc < a->offset.data() + a->offset.cap);
+    CheckRowCursors(*a, ic, vc, lc, oc);
     *lc++ = label;
     off += (int64_t)row_nnz;
     *oc++ = off;

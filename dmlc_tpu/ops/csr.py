@@ -24,7 +24,12 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["spmv", "segment_spmv", "csr_to_dense", "csr_to_padded_rows",
-           "sdot_rows", "csr_row_ids", "sharded_spmv"]
+           "sdot_rows", "csr_row_ids", "sharded_spmv", "segment_sum"]
+
+# The ONE spelling of segment-sum used across the package (models/fm.py
+# and every op here): jax.ops.segment_sum is the supported public API in
+# the pinned JAX; if it ever moves, this is the single line to update.
+segment_sum = jax.ops.segment_sum
 
 
 def csr_row_ids(offset: jnp.ndarray, nnz: int) -> jnp.ndarray:
@@ -48,8 +53,8 @@ def segment_spmv(offset: jnp.ndarray, index: jnp.ndarray,
     """
     row_ids = csr_row_ids(offset, index.shape[0])
     contrib = value * jnp.take(weights, index.astype(jnp.int32), axis=0)
-    return jax.ops.segment_sum(contrib, row_ids.astype(jnp.int32),
-                               num_segments=num_rows)
+    return segment_sum(contrib, row_ids.astype(jnp.int32),
+                       num_segments=num_rows)
 
 
 def spmv(offset, index, value, weights) -> jnp.ndarray:

@@ -325,6 +325,32 @@ class TestShardLocalRestore:
         np.testing.assert_array_equal(np.asarray(restored["x"]),
                                       np.asarray(tree["x"]))
 
+    def test_save_over_interrupted_swap_crash_keeps_committed(
+            self, tmp_path, monkeypatch):
+        # r4 regression (code review): start from the mid-swap state
+        # (step dir missing, .new fully committed — the step's ONLY
+        # committed copy). A save of that step must NOT invalidate the
+        # committed .new before a replacement exists: crash the save
+        # during shard writing and the old data must still restore.
+        tree, mesh, sh = self._tree()
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        d = ck.save(1, tree)
+        os.rename(d, d + ".new")  # exactly the mid-swap on-disk state
+        bomb = RuntimeError("simulated crash mid shard write")
+
+        def boom(leaf):
+            raise bomb
+
+        monkeypatch.setattr(ShardedCheckpoint, "_addressable_shards",
+                            staticmethod(boom))
+        with pytest.raises(RuntimeError):
+            ck.save(1, {"x": np.zeros_like(np.asarray(tree["x"]))})
+        monkeypatch.undo()
+        assert ck.latest_step() == 1  # the old committed copy survived
+        restored, _ = ck.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(tree["x"]))
+
     def test_replicated_target_restores(self, tmp_path):
         tree, mesh, _ = self._tree()
         ck = ShardedCheckpoint(str(tmp_path / "r"))
