@@ -6,12 +6,16 @@ the multi-part/multi-host shard shape is bench_suite config 4, which
 runs all parts with concurrent pipelines) → native C++ parse → zero-copy
 CSR views → async jax.device_put into device memory, transfers riding
 under parse via detached leases. Prints exactly ONE JSON line:
-{"metric", "value", "unit", "vs_baseline", "best_epoch", "epochs"} —
-"value" is the SUSTAINED rate (20%-trimmed mean of per-epoch GB/s over
->= 5 epochs / >= the time budget), "best_epoch" the fastest single
-epoch, and vs_baseline is value / 2.0 (the BASELINE.json target of
-2 GB/s/chip; the reference publishes no numbers of its own, see
-BASELINE.md).
+{"metric", "value", "unit", "vs_baseline", "best_epoch", "epochs",
+"bound", "parse_cpu_gbps_core"} — "value" is the SUSTAINED rate
+(20%-trimmed mean of per-epoch GB/s over >= 5 epochs / >= the time
+budget), "best_epoch" the fastest single epoch, "parse_cpu_gbps_core"
+the thread-CPU parse rate (immune to this burstable VM's credit
+scheduler — the three numbers are: what the run sustained, what the
+hardware burst can do, what the kernel itself does per core), "bound"
+whether the best epoch waited mainly on transfers or on parse, and
+vs_baseline is value / 2.0 (the BASELINE.json target of 2 GB/s/chip;
+the reference publishes no numbers of its own, see BASELINE.md).
 
 Secondary diagnostics go to stderr.
 """
@@ -99,7 +103,8 @@ def main() -> None:
         t0 = time.perf_counter()
         rows = nnz = 0
         in_flight = []  # (future, lease): lease released after transfer
-        t_pull = 0.0
+        t_pull = 0.0   # waiting on the parser (parse-bound symptom)
+        t_xfer = 0.0   # waiting on device transfers (transfer-bound)
         tp0 = time.perf_counter()
         while parser.next():
             t_pull += time.perf_counter() - tp0
@@ -115,16 +120,21 @@ def main() -> None:
                  "index": block.index, "value": block.value}, dev), lease))
             if len(in_flight) > 4:
                 fut, ls = in_flight.pop(0)
+                tx0 = time.perf_counter()
                 jax.block_until_ready(fut)
+                t_xfer += time.perf_counter() - tx0
                 if ls is not None:
                     ls.release()
             tp0 = time.perf_counter()
         for fut, ls in in_flight:
+            tx0 = time.perf_counter()
             jax.block_until_ready(fut)
+            t_xfer += time.perf_counter() - tx0
             if ls is not None:
                 ls.release()
         stats = parser.stats() if hasattr(parser, "stats") else None
-        return time.perf_counter() - t0, t_pull, rows, nnz, stats
+        return (time.perf_counter() - t0, t_pull, t_xfer, rows, nnz,
+                stats)
 
     # Sustained measurement (VERDICT r2 #2): run at least min_epochs
     # passes AND keep sampling for the full time budget, then report the
@@ -146,15 +156,17 @@ def main() -> None:
     times = []
     best = None
     best_stats = None
+    best_waits = (0.0, 0.0)
     t_start = time.perf_counter()
     i = 0
     while True:
-        dt, t_pull, rows, nnz, stats = epoch()
+        dt, t_pull, t_xfer, rows, nnz, stats = epoch()
         times.append(dt)
         log(f"epoch {i}: rows={rows} nnz={nnz} wall={dt:.2f}s "
-            f"pull-wait={t_pull:.2f}s -> {size / dt / 1e9:.3f} GB/s")
+            f"pull-wait={t_pull:.2f}s xfer-wait={t_xfer:.2f}s "
+            f"-> {size / dt / 1e9:.3f} GB/s")
         if best is None or dt < best:
-            best, best_stats = dt, stats
+            best, best_stats, best_waits = dt, stats, (t_pull, t_xfer)
         i += 1
         elapsed = time.perf_counter() - t_start
         if i >= min_epochs and elapsed > budget_s:
@@ -176,8 +188,22 @@ def main() -> None:
         parser.destroy()
 
     best_gbps = size / best / 1e9
+    # Credit-immune kernel rate (VERDICT r3 #4): thread-CPU time spent
+    # parsing, immune to this burstable VM's credit scheduler and to
+    # the consumer thread preempting workers on a 1-core host.
+    parse_cpu_gbps = None
+    if best_stats and best_stats.get("parse_cpu_ns"):
+        parse_cpu_gbps = size / best_stats["parse_cpu_ns"]
+    # Which side bounds the pipeline (VERDICT r3 #1): the consumer
+    # either waits on the parser (parse-bound) or on device transfers
+    # (transfer-bound). On this box the transfer side is the tunnel's
+    # burst shaping — see dmlc_tpu.bench_transfer / BASELINE.md.
+    pull_s, xfer_s = best_waits
+    bound = "transfer" if xfer_s > pull_s else "parse"
     log(f"sustained (trimmed mean of {len(times)} epochs) = "
-        f"{sustained:.3f} GB/s; best epoch = {best_gbps:.3f} GB/s")
+        f"{sustained:.3f} GB/s; best epoch = {best_gbps:.3f} GB/s; "
+        f"bound={bound} (pull-wait {pull_s:.2f}s vs xfer-wait "
+        f"{xfer_s:.2f}s in best epoch)")
     print(json.dumps({
         "metric": "libsvm_parse_to_hbm_throughput",
         "value": round(sustained, 4),
@@ -185,6 +211,11 @@ def main() -> None:
         "vs_baseline": round(sustained / TARGET_GBPS, 4),
         "best_epoch": round(best_gbps, 4),
         "epochs": len(times),
+        "bound": bound,
+        # null when the engine exposes no thread-CPU stats (python
+        # fallback) — the key is always present for consumers
+        "parse_cpu_gbps_core": (round(parse_cpu_gbps, 4)
+                                if parse_cpu_gbps is not None else None),
     }))
 
 

@@ -153,6 +153,109 @@ class TestShardedRowBlockIter:
         padded = pad_to_bucket(b, 4, 16)
         assert (padded["weight"] == 0).all()
 
+    @staticmethod
+    def _write_libsvm(path, rng, n):
+        lines = [f"{i % 2} {rng.randint(0, 50)}:{rng.rand():.6f}".encode()
+                 for i in range(n)]
+        path.write_bytes(b"\n".join(lines) + b"\n")
+
+    def _collect(self, it):
+        out = []
+        for gb in it:
+            out.append({k: np.asarray(v) for k, v in gb.items()})
+        return out
+
+    def test_over_budget_fallback_matches_cached_path(self, mesh, tmp_path,
+                                                      rng):
+        # agreement_cache_bytes=0 forces the legacy per-round protocol;
+        # the batch stream must be identical to the cached fast path
+        p = tmp_path / "d.libsvm"
+        self._write_libsvm(p, rng, 200)
+        kw = dict(format="libsvm", row_bucket=32, nnz_bucket=64,
+                  prefetch=False)
+        fast = self._collect(ShardedRowBlockIter(
+            str(p), mesh, first_epoch_cache="always", **kw))
+        slow = self._collect(ShardedRowBlockIter(
+            str(p), mesh, first_epoch_cache="always",
+            agreement_cache_bytes=0, **kw))
+        assert len(fast) == len(slow) > 0
+        for a, b in zip(fast, slow):
+            assert set(a) == set(b)
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+
+    @pytest.mark.parametrize("cache_mode", ["always", "never"])
+    def test_epoch_replay_detects_truncated_file(self, mesh, tmp_path, rng,
+                                                 cache_mode):
+        # VERDICT r3 #7: steady-state epochs trust the epoch-1 round
+        # count; a file truncated between epochs must raise loudly, not
+        # silently desynchronize the collective batch contract.
+        # Truncation lands on a line boundary so every remaining byte
+        # parses cleanly — only the replay-length check can catch it.
+        from dmlc_tpu.utils.logging import DMLCError
+        p = tmp_path / "d.libsvm"
+        self._write_libsvm(p, rng, 300)
+        it = ShardedRowBlockIter(str(p), mesh, format="libsvm",
+                                 row_bucket=8, nnz_bucket=16,
+                                 prefetch=False,
+                                 first_epoch_cache=cache_mode)
+        n1 = len(self._collect(it))
+        assert n1 > 0
+        data = p.read_bytes()
+        cut = data.index(b"\n", len(data) // 4) + 1
+        p.write_bytes(data[:cut])  # clean truncation at a line boundary
+        with pytest.raises(DMLCError, match="changed between epochs"):
+            self._collect(it)
+
+    def test_epoch_replay_detects_rewritten_file(self, mesh, tmp_path, rng):
+        # a rewrite with different bytes typically breaks mid-token at
+        # the old shard boundaries; the replay wraps the parse error
+        # with the file-mutation context
+        from dmlc_tpu.utils.logging import DMLCError
+        p = tmp_path / "d.libsvm"
+        self._write_libsvm(p, rng, 300)
+        it = ShardedRowBlockIter(str(p), mesh, format="libsvm",
+                                 row_bucket=8, nnz_bucket=16,
+                                 prefetch=False)
+        assert len(self._collect(it)) > 0
+        self._write_libsvm(p, rng, 40)  # rewrite, much shorter
+        with pytest.raises(DMLCError, match="changed between epochs"):
+            self._collect(it)
+
+    def test_epoch_replay_ignores_appended_data(self, mesh, tmp_path, rng):
+        # shard byte-ranges are captured at creation, so data APPENDED
+        # after the iterator was built is invisible: replay stays loyal
+        # to epoch 1 (documented behavior, not a hazard)
+        p = tmp_path / "d.libsvm"
+        self._write_libsvm(p, rng, 150)
+        it = ShardedRowBlockIter(str(p), mesh, format="libsvm",
+                                 row_bucket=32, nnz_bucket=64,
+                                 prefetch=False)
+        e1 = self._collect(it)
+        with open(p, "ab") as f:
+            f.write(b"1 3:0.5\n" * 200)
+        e2 = self._collect(it)
+        assert len(e1) == len(e2)
+        for a, b in zip(e1, e2):
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+
+    def test_second_epoch_matches_first(self, mesh, tmp_path, rng):
+        # the steady-state replay (no collectives, counted rounds) must
+        # reproduce epoch 1's batches exactly
+        p = tmp_path / "d.libsvm"
+        self._write_libsvm(p, rng, 150)
+        it = ShardedRowBlockIter(str(p), mesh, format="libsvm",
+                                 row_bucket=32, nnz_bucket=64,
+                                 prefetch=False,
+                                 first_epoch_cache="always")
+        e1 = self._collect(it)
+        e2 = self._collect(it)
+        assert len(e1) == len(e2)
+        for a, b in zip(e1, e2):
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+
 
 class TestDevicePrefetch:
     def test_preserves_order_and_values(self, rng):
