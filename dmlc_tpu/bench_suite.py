@@ -18,6 +18,8 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                the Python golden, digest-checked
   7. multiprocess — REAL 2-process jax.distributed collective ingest
                cadence (steady-state vs agreement epoch)
+  8. page_replay — binary page cache replay → device HBM, parse
+               skipped (DiskRowIter pages; the repeated-epoch shape)
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 """
@@ -522,6 +524,83 @@ def bench_multiprocess_ingest(mb: int) -> Dict:
             "steady_over_first": round(first / steady, 2)}
 
 
+def bench_page_replay(mb: int, rows_per_page: int = 8 << 10) -> Dict:
+    """Binary page replay → device HBM, parse skipped (VERDICT r3 #2).
+
+    The reference's own larger-than-RAM answer to "parse is expensive"
+    (src/data/disk_row_iter.h): parse once, spill versioned binary
+    pages, replay pages on every later epoch. Build pass (untimed):
+    text → DiskRowIter page cache. Timed region: page reads → async
+    device_put of the CSR arrays with a small in-flight window — the
+    epoch shape repeated-epoch training actually uses. Parity: the
+    replayed stream concatenates to the SAME content hash as a direct
+    parse of the text (checked untimed).
+
+    rows_per_page defaults to ~4 MB pages on the criteo shape — the
+    measured transfer sweet spot (BASELINE.md "Transfer ceiling").
+    Reports gbps over PAGE bytes (the IO this path performs) and
+    text_equiv_gbps over the text bytes the replay stands in for
+    (comparable with config 1's parse number)."""
+    import jax
+
+    from dmlc_tpu.data.row_iter import DiskRowIter, RowBlockIter
+    from dmlc_tpu.data.rowblock import RowBlockContainer
+
+    path = f"{_TMP}.pagerep.libsvm"
+    size = make_libsvm(path, mb, seed=7, nnz_range=(25, 45),
+                       index_space=10 ** 6, real_values=True)
+    # page size is baked into the cache at build time: key the filename
+    # by it so a run with a different rows_per_page never silently
+    # reuses pages of another size
+    cache = f"{_TMP}.pagerep.rp{rows_per_page}.pages"
+    if os.path.exists(cache) and \
+            os.path.getmtime(cache) < os.path.getmtime(path):
+        os.remove(cache)  # text regenerated: the page cache is stale
+    t_build0 = time.perf_counter()
+    from dmlc_tpu.data.parser import Parser
+    it = DiskRowIter(lambda: Parser.create(path, 0, 1, format="libsvm"),
+                     cache, rows_per_page=rows_per_page)
+    build_s = time.perf_counter() - t_build0
+    page_bytes = os.path.getsize(cache)
+    dev = jax.devices()[0]
+
+    def replay_epoch() -> float:
+        it.before_first()
+        in_flight: List = []
+        t0 = time.perf_counter()
+        while it.next():
+            b = it.value()
+            in_flight.append(jax.device_put(
+                {"offset": b.offset, "label": b.label,
+                 "index": b.index, "value": b.value}, dev))
+            if len(in_flight) > 4:
+                jax.block_until_ready(in_flight.pop(0))
+        for fut in in_flight:
+            jax.block_until_ready(fut)
+        return time.perf_counter() - t0
+
+    walls = [replay_epoch() for _ in range(3)]
+    best = min(walls)
+    # parity: replayed pages == direct parse, byte-identical CSR
+    c = RowBlockContainer(np.uint32)
+    it.before_first()
+    while it.next():
+        c.push_block(it.value())
+    replay_hash = c.get_block().content_hash()
+    parse_hash = _content_hash(path, "libsvm")
+    assert replay_hash == parse_hash, \
+        f"page replay diverged from parse: {replay_hash} != {parse_hash}"
+    return {"config": "page_replay_to_hbm", "gbps": page_bytes / best / 1e9,
+            "bytes": page_bytes, "text_bytes": size,
+            "text_equiv_gbps": round(size / best / 1e9, 4),
+            "build_s": round(build_s, 3),
+            "epoch_walls": [round(w, 3) for w in walls],
+            # a CPU-backend run measures host-to-host copies, not HBM —
+            # the platform disambiguates the number
+            "platform": dev.platform,
+            "hash": replay_hash}
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -530,13 +609,14 @@ CONFIGS = {
     5: ("parquet", lambda mb, dev: bench_parquet(mb)),
     6: ("indexed_shuffled", lambda mb, dev: bench_indexed_shuffled(mb)),
     7: ("multiprocess", lambda mb, dev: bench_multiprocess_ingest(mb)),
+    8: ("page_replay", lambda mb, dev: bench_page_replay(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-7 (0 = all)")
+                    help="1-8 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -550,9 +630,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         _log(f"— config {n} ({name}), ~{args.mb} MB —")
         try:
             # config 7's steady-state metric already self-warms (epochs
-            # 2-3 of one gang); a second full 2-process launch would be
-            # pure wasted minutes
-            if not args.cold and n != 7:
+            # 2-3 of one gang) and config 8 takes best-of-3 replay
+            # epochs over a build it performs itself — a second full run
+            # of either would be pure wasted minutes
+            if not args.cold and n not in (7, 8):
                 fn(args.mb, args.device)  # warm imports + page cache
             out = fn(args.mb, args.device)
             out["gbps"] = round(out["gbps"], 4)

@@ -142,8 +142,11 @@ class TPUSeekStream(SeekStream):
 
         The 4 MB default chunk matches the measured transfer sweet spot
         on the v5e tunnel (r3: pooled 1.28 GB/s median vs 1.14 unpooled
-        at 4 MB over 5 interleaved runs; BOTH modes fall off a cliff to
-        ~0.2 GB/s at 8 MB chunks — see BASELINE.md)."""
+        at 4 MB over 5 interleaved runs). r4 re-measured the ceiling:
+        fresh-state single stream does 1.5-1.7 GB/s at 1-4 MB chunks,
+        8 MB+ is never better, and the dramatic collapses are the
+        tunnel's burst shaping, not chunk size — see BASELINE.md
+        "Transfer ceiling" and dmlc_tpu.bench_transfer."""
         import jax
         from dmlc_tpu.utils.memory import thread_local_pool
         check(lookahead >= 1, "lookahead must be >= 1")
