@@ -5,11 +5,12 @@ exist to close the TPU loop — prove that HBM-resident CSR batches train a
 real learner end-to-end under jit/shard_map. SparseLinearModel is the
 flagship: the logistic-regression core of the linear XGBoost booster
 family, consuming exactly the sharded batch layout dmlc_tpu.parallel
-produces. SparseFMModel (second-order factorization machine) is the
-canonical consumer of the libfm format family.
+produces. SparseFMModel (second-order FM) and SparseFFMModel (field-aware,
+consuming the libfm field[] column) are the
+canonical consumers of the libfm format family.
 """
 
-from dmlc_tpu.models.fm import SparseFMModel
+from dmlc_tpu.models.fm import SparseFFMModel, SparseFMModel
 from dmlc_tpu.models.linear import SparseLinearModel
 
-__all__ = ["SparseLinearModel", "SparseFMModel"]
+__all__ = ["SparseLinearModel", "SparseFMModel", "SparseFFMModel"]

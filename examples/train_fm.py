@@ -1,9 +1,11 @@
-"""End-to-end example: libfm ingest -> factorization-machine training.
+"""End-to-end example: libfm ingest -> FM and field-aware FFM training.
 
 The libfm format family closed into a loop: LibFMParser (reference:
 src/data/libfm_parser.h) parses field:index:value text, and
 SparseFMModel — the second-order FM that format family exists to feed —
-trains on the resulting CSR batches under shard_map. The training data
+trains on the resulting CSR batches under shard_map — followed by
+SparseFFMModel, which additionally consumes the parsed field[] column
+(fields flow text -> parser -> padded batch -> device). The training data
 follows a pure INTERACTION rule (label = XOR over feature pairs), which
 a linear model provably cannot fit and the FM's pairwise term can.
 
@@ -30,7 +32,7 @@ else:
     except RuntimeError:  # preset platform unavailable -> CPU fallback
         jax.config.update("jax_platforms", "cpu")
 
-from dmlc_tpu.models import SparseFMModel  # noqa: E402
+from dmlc_tpu.models import SparseFFMModel, SparseFMModel  # noqa: E402
 from dmlc_tpu.parallel import ShardedRowBlockIter  # noqa: E402
 from dmlc_tpu.io.tempdir import TemporaryDirectory  # noqa: E402
 
@@ -50,7 +52,7 @@ def make_libfm(path: str) -> None:
             feats = sorted({2 * a + b, 2 * NPAIRS + cbit})
             y = 1 if b == cbit else 0
             # field:index:value — field 0 = pair features, 1 = context
-            # (plain FM ignores fields; an FFM extension would use them)
+            # (plain FM ignores fields; the FFM below consumes them)
             toks = " ".join(
                 f"{0 if j < 2 * NPAIRS else 1}:{j}:1" for j in feats)
             f.write(f"{y} {toks}\n")
@@ -71,17 +73,46 @@ def main() -> None:
         model = SparseFMModel(NCOL, num_factors=4, learning_rate=1.0)
         params = jax.device_put(model.init_params(seed=2))
         step = model.make_sharded_train_step(mesh)
+        # field-aware FFM on the same batches: the field[] column the
+        # libfm parser filled is consumed on device
+        ffm = SparseFFMModel(NCOL, num_fields=2, num_factors=4,
+                             learning_rate=1.0)
+        fparams = jax.device_put(ffm.init_params(seed=2))
+        fstep = ffm.make_sharded_train_step(mesh)
 
+        ffm.validate_batch(batches[0])  # field ids fit num_fields
+
+        # compile BOTH programs up front: on a starved shared host, a
+        # multi-second XLA compile wedged between training loops can
+        # stall one virtual device past the CPU collectives' rendezvous
+        # timeout — front-loading the compiles keeps the loops' tiny
+        # per-step executions as the only collective work
         _, loss0 = step(params, batches[0])
-        for epoch in range(EPOCHS):
-            for batch in batches:
-                params, loss = step(params, batch)
-            if (epoch + 1) % 20 == 0:
-                print(f"epoch {epoch + 1}: loss {float(loss):.4f}")
-        _, loss1 = step(params, batches[0])
-        print(f"loss {float(loss0):.4f} -> {float(loss1):.4f} "
+        _, f0 = fstep(fparams, batches[0])
+
+        def train(step_fn, p, tag):
+            for epoch in range(EPOCHS):
+                for batch in batches:
+                    p, loss = step_fn(p, batch)
+                # per-epoch sync bounds the async dispatch backlog: on a
+                # starved shared host, hundreds of queued 8-device
+                # collectives can spread one collective's thread
+                # arrivals past the CPU rendezvous watchdog
+                loss = float(loss)
+                if (epoch + 1) % 20 == 0:
+                    print(f"{tag} epoch {epoch + 1}: loss {loss:.4f}")
+            _, final = step_fn(p, batches[0])
+            return float(final)
+
+        loss1 = train(step, params, "FM")
+        print(f"loss {float(loss0):.4f} -> {loss1:.4f} "
               f"(pure-interaction rule: a linear model stays ~0.69)")
-        assert float(loss1) < 0.3, "FM failed to learn the XOR rule"
+        assert loss1 < 0.3, "FM failed to learn the XOR rule"
+
+        f1 = train(fstep, fparams, "FFM")
+        print(f"FFM: loss {float(f0):.4f} -> {f1:.4f} "
+              f"(field[] parsed from text and consumed on device)")
+        assert f1 < 0.3, "FFM failed to learn the XOR rule"
         print("OK")
 
 

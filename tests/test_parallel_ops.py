@@ -408,3 +408,147 @@ class TestSparseFMModel:
         params = model.init_params()
         _, l0 = model.train_step(params, batch)
         assert np.isfinite(float(l0))
+
+
+class TestSparseFFMModel:
+    """Field-aware FM — the consumer of the libfm field[] column
+    (VERDICT r3 #8): forward must match the brute-force pairwise FFM
+    definition (which proves the field pairing is real, not FM in
+    disguise), and the sharded step must match the flat step."""
+
+    @staticmethod
+    def _ffm_batch(rng, rows, ncol, nfields, row_bucket, nnz_bucket):
+        c = RowBlockContainer(np.uint32)
+        fields = rng.randint(0, nfields, size=ncol)  # feature -> field
+        for _ in range(rows):
+            nnz = rng.randint(1, 6)
+            idx = np.sort(rng.choice(ncol, nnz, replace=False))
+            c.push(float(rng.randint(0, 2) * 2 - 1), idx,
+                   rng.rand(nnz).astype(np.float32),
+                   fields=fields[idx].astype(np.int64))
+        block = c.get_block()
+        assert block.field is not None
+        return pad_to_bucket(block, row_bucket, nnz_bucket), block
+
+    @staticmethod
+    def _brute_force_margins(params, block):
+        """The FFM definition verbatim: b + Σ w_i x_i +
+        Σ_{i<j} <v_{i,f_j}, v_{j,f_i}> x_i x_j, row by row."""
+        w = np.asarray(params["w"])
+        V = np.asarray(params["V"])
+        bias = float(params["b"])
+        out = []
+        for r in range(block.size):
+            s, e = int(block.offset[r]), int(block.offset[r + 1])
+            idx = block.index[s:e].astype(int)
+            val = block.value[s:e].astype(np.float64)
+            fld = block.field[s:e].astype(int)
+            m = bias + float((w[idx] * val).sum())
+            for a in range(len(idx)):
+                for b2 in range(a + 1, len(idx)):
+                    m += float(np.dot(V[idx[a], fld[b2]],
+                                      V[idx[b2], fld[a]])
+                               * val[a] * val[b2])
+            out.append(m)
+        return np.array(out, np.float64)
+
+    def test_forward_matches_brute_force(self, rng):
+        from dmlc_tpu.models import SparseFFMModel
+        ncol, nfields = 20, 3
+        batch, block = self._ffm_batch(rng, 64, ncol, nfields, 64, 512)
+        model = SparseFFMModel(ncol, nfields, num_factors=4)
+        params = model.init_params(seed=1)
+        got = np.asarray(model.forward(params, batch))[: block.size]
+        want = self._brute_force_margins(params, block)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_training_fits_planted_ffm_signal(self, rng):
+        # labels come from a TEACHER FFM (brute-force margins of random
+        # teacher params): a learnable field-aware signal, so training
+        # must fit it well — random labels would only allow memorization
+        from dmlc_tpu.models import SparseFFMModel
+        ncol, nfields = 16, 4
+        batch, block = self._ffm_batch(rng, 256, ncol, nfields, 256, 2048)
+        teacher = SparseFFMModel(ncol, nfields, num_factors=4,
+                                 init_scale=1.0)
+        margins = self._brute_force_margins(teacher.init_params(seed=9),
+                                            block)
+        batch["label"][: block.size] = np.where(margins > np.median(
+            margins), 1.0, -1.0).astype(np.float32)
+        model = SparseFFMModel(ncol, nfields, num_factors=4,
+                               learning_rate=2.0, init_scale=0.1)
+        params = model.init_params(seed=2)
+        losses = []
+        for _ in range(200):
+            params, loss = model.train_step(params, batch)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.72, losses[::40]
+
+    def test_sharded_step_matches_single_chip(self, mesh, rng):
+        from dmlc_tpu.models import SparseFFMModel
+        ncol, nfields = 18, 3
+        per_dev = [self._ffm_batch(rng, 8, ncol, nfields, 8, 64)
+                   for _ in range(8)]
+        locals_ = [b for b, _ in per_dev]
+        gb = make_global_batch(stack_device_batches(locals_), mesh)
+        model = SparseFFMModel(ncol, nfields, num_factors=2,
+                               learning_rate=0.1)
+        params = model.init_params(seed=4)
+        p1, loss_sharded = model.make_sharded_train_step(mesh)(params, gb)
+
+        c = RowBlockContainer(np.uint32)
+        for _, blk in per_dev:
+            c.push_block(blk)
+        flat = pad_to_bucket(c.get_block(), 64, 512)
+        p2, loss_flat = model.train_step(params, flat)
+        assert float(loss_sharded) == pytest.approx(float(loss_flat),
+                                                    rel=1e-5)
+        np.testing.assert_allclose(np.asarray(p1["V"]), np.asarray(p2["V"]),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_validate_batch_rejects_out_of_range_fields(self, rng):
+        # the jitted forward CLIPS out-of-range field ids (XLA gather
+        # must be in-bounds) — the host-side validator is what turns a
+        # num_fields misconfiguration into an error instead of silent
+        # field merging
+        from dmlc_tpu.models import SparseFFMModel
+        from dmlc_tpu.utils.logging import DMLCError
+        batch, _ = self._ffm_batch(rng, 16, 12, 5, 16, 128)
+        model = SparseFFMModel(12, num_fields=2, num_factors=2)
+        with pytest.raises(DMLCError, match="num_fields"):
+            model.validate_batch(batch)
+        SparseFFMModel(12, num_fields=5).validate_batch(batch)  # fits
+
+    def test_libfm_file_to_ffm_training(self, tmp_path, rng):
+        """End-to-end: libfm text → Parser → padded batch WITH field →
+        FFM step — field[] flows to the device and is consumed."""
+        from dmlc_tpu.models import SparseFFMModel
+        ncol, nfields = 16, 4
+        lines = []
+        for i in range(200):
+            nnz = rng.randint(1, 6)
+            idx = np.sort(rng.choice(ncol, nnz, replace=False))
+            toks = " ".join(
+                f"{rng.randint(0, nfields)}:{j}:{rng.rand():.4f}"
+                for j in idx)
+            lines.append(f"{i % 2} {toks}")
+        p = tmp_path / "d.libfm"
+        p.write_text("\n".join(lines) + "\n")
+        c = RowBlockContainer(np.uint32)
+        parser = Parser.create(str(p), 0, 1, format="libfm")
+        for b in parser:
+            c.push_block(b)
+        if hasattr(parser, "destroy"):
+            parser.destroy()
+        block = c.get_block()
+        batch = pad_to_bucket(block, next_pow2_bucket(block.size),
+                              next_pow2_bucket(block.nnz))
+        assert "field" in batch
+        model = SparseFFMModel(ncol, nfields, num_factors=2,
+                               learning_rate=0.3)
+        params = model.init_params()
+        losses = []
+        for _ in range(15):
+            params, loss = model.train_step(params, batch)
+            losses.append(float(loss))
+        assert np.isfinite(losses[-1]) and losses[-1] <= losses[0]
