@@ -217,15 +217,17 @@ class SparseFFMModel(_SparseFactorModelBase):
 
     def validate_batch(self, batch: Dict[str, Any]) -> None:
         """Host-side guard (cannot run under jit, where values are
-        tracers): every field id must be < num_fields."""
+        tracers): every field id must be in [0, num_fields)."""
         import numpy as np
         from dmlc_tpu.utils.logging import check
         f = np.asarray(batch["field"])
         mx = int(f.max()) if f.size else 0
-        check(mx < self.num_fields,
-              f"FFM batch carries field id {mx} but the model was built "
-              f"with num_fields={self.num_fields} — the jitted forward "
-              "would silently clip it; fix num_fields or the data")
+        mn = int(f.min()) if f.size else 0
+        check(0 <= mn and mx < self.num_fields,
+              f"FFM batch carries field ids [{mn}, {mx}] but the model "
+              f"was built with num_fields={self.num_fields} — the jitted "
+              "forward would silently clip them; fix num_fields or the "
+              "data")
 
     def __init__(self, num_features: int, num_fields: int,
                  num_factors: int = 4, l2: float = 0.0,

@@ -518,6 +518,9 @@ class TestSparseFFMModel:
         with pytest.raises(DMLCError, match="num_fields"):
             model.validate_batch(batch)
         SparseFFMModel(12, num_fields=5).validate_batch(batch)  # fits
+        batch["field"][0] = -1  # negative sentinel: also clipped → error
+        with pytest.raises(DMLCError, match="field ids"):
+            SparseFFMModel(12, num_fields=5).validate_batch(batch)
 
     def test_libfm_file_to_ffm_training(self, tmp_path, rng):
         """End-to-end: libfm text → Parser → padded batch WITH field →
