@@ -40,6 +40,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <unordered_map>
 #include <string>
 #include <thread>
 #include <type_traits>
@@ -324,25 +325,116 @@ inline bool parse_i64(const char* b, const char* e, int64_t* out) {
 
 // ---------------------------------------------------------------- CSR arena
 
+// Process-global, size-classed freelist of big parse-buffer blocks.
+// Why: arena backing stores are multi-MB and cannot recycle through the
+// parser's arena_pool while consumers hold zero-copy leases (every
+// chunk then needs a FRESH arena), and first-touch faulting a fresh
+// multi-MB block costs ~1.5us per 4 KB page — measured 25-30% of the
+// whole a1a-shape parse (r4, BASELINE.md). Reusing WARM blocks across
+// arenas removes the faults. Pow2 size classes make hits likely across
+// equal-sized chunks; the cache is bounded (default 512 MB, env
+// DMLC_TPU_BLOCK_CACHE_MB, 0 disables) so RSS stays bounded — the soak
+// test pins that. Lock is per reserve/free (per-slice, off the token
+// hot path).
+class BlockCache {
+ public:
+  static BlockCache& I() {
+    static BlockCache c;
+    return c;
+  }
+
+  // pow2-rounded `bytes` (the caller's size class); nullptr on miss
+  void* Get(size_t bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = free_.find(bytes);
+    if (it == free_.end() || it->second.empty()) return nullptr;
+    void* p = it->second.back();
+    it->second.pop_back();
+    held_ -= bytes;
+    return p;
+  }
+
+  // true = cache took ownership; false = caller frees. Called from
+  // ~Buf (implicitly noexcept): the map/vector insertion may itself
+  // allocate, so an allocation failure must surface as "not cached",
+  // never as an exception escaping a destructor.
+  bool Put(void* p, size_t bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (held_ + bytes > cap_) return false;
+    try {
+      free_[bytes].push_back(p);
+    } catch (...) {
+      return false;
+    }
+    held_ += bytes;
+    return true;
+  }
+
+ private:
+  BlockCache() {
+    if (const char* env = std::getenv("DMLC_TPU_BLOCK_CACHE_MB"))
+      cap_ = (size_t)std::max(0L, std::atol(env)) << 20;
+  }
+  ~BlockCache() {
+    for (auto& kv : free_)
+      for (void* p : kv.second) ::operator delete(p);
+  }
+  std::mutex mu_;
+  std::unordered_map<size_t, std::vector<void*>> free_;
+  size_t held_ = 0;
+  size_t cap_ = (size_t)512 << 20;
+};
+
 // Growable POD buffer without std::vector's per-push capacity check cost
 // on the hot path: parse loops reserve a worst-case bound once per slice
 // (virtual memory is cheap; untouched pages never fault) and write through
 // raw cursors, syncing the size afterwards. Checked push_back remains for
-// cold paths.
+// cold paths. Blocks >= kCacheMin bytes allocate through BlockCache.
 template <typename T>
 struct Buf {
   static_assert(std::is_trivially_copyable<T>::value,
                 "Buf skips constructors; element type must be POD");
-  std::unique_ptr<T[]> d;
+  static constexpr size_t kCacheMin = (size_t)1 << 20;
+  T* d = nullptr;
   size_t n = 0, cap = 0;
+  size_t alloc_bytes = 0;  // pow2 size class of d (0 = plain new)
+
+  Buf() = default;
+  Buf(const Buf&) = delete;
+  Buf& operator=(const Buf&) = delete;
+  ~Buf() { release_block(); }
+
+  static size_t round_pow2(size_t v) {
+    size_t p = 4096;
+    while (p < v) p <<= 1;
+    return p;
+  }
+
+  void release_block() {
+    if (!d) return;
+    if (alloc_bytes >= kCacheMin && BlockCache::I().Put(d, alloc_bytes)) {
+      // warm block parked for the next arena
+    } else {
+      ::operator delete(d);
+    }
+    d = nullptr;
+    cap = 0;
+    alloc_bytes = 0;
+  }
 
   void reserve(size_t want) {
     if (want <= cap) return;
     size_t ncap = std::max(want, cap * 2);
-    std::unique_ptr<T[]> nd(new T[ncap]);  // POD: uninitialized, no memset
-    if (n) std::memcpy(nd.get(), d.get(), n * sizeof(T));
-    d = std::move(nd);
-    cap = ncap;
+    size_t bytes = round_pow2(ncap * sizeof(T));
+    T* nd = nullptr;
+    if (bytes >= kCacheMin)
+      nd = static_cast<T*>(BlockCache::I().Get(bytes));
+    if (!nd) nd = static_cast<T*>(::operator new(bytes));
+    if (n) std::memcpy(nd, d, n * sizeof(T));
+    release_block();  // resets d/cap/alloc_bytes only; n is preserved
+    d = nd;
+    cap = bytes / sizeof(T);
+    alloc_bytes = bytes;
   }
 
   void push_back(T v) {
@@ -353,16 +445,16 @@ struct Buf {
   void append(const Buf& o) {
     if (o.n == 0) return;  // o.d may be null; memcpy(_, null, 0) is UB
     reserve(n + o.n);
-    std::memcpy(d.get() + n, o.d.get(), o.n * sizeof(T));
+    std::memcpy(d + n, o.d, o.n * sizeof(T));
     n += o.n;
   }
 
-  T* data() { return d.get(); }
-  const T* data() const { return d.get(); }
-  T* begin() { return d.get(); }
-  T* end() { return d.get() + n; }
-  const T* begin() const { return d.get(); }
-  const T* end() const { return d.get() + n; }
+  T* data() { return d; }
+  const T* data() const { return d; }
+  T* begin() { return d; }
+  T* end() { return d + n; }
+  const T* begin() const { return d; }
+  const T* end() const { return d + n; }
   T& back() { return d[n - 1]; }
   T& operator[](size_t i) { return d[i]; }
   const T& operator[](size_t i) const { return d[i]; }
@@ -1024,8 +1116,12 @@ inline void CheckRowCursors(const CSRArena& a, const uint32_t* ic,
         "(token-size invariant violated; please report)"};
 }
 
-// parse [b, e) of whole text records into arena; throws EngineError
-void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
+// parse [b, e) of whole text records into arena; throws EngineError.
+// kShortFast compiles in the fused short-token fast path — worth +27%
+// on the a1a shape class but a measured -13% tax on criteo-length
+// tokens, so the dispatcher below picks per slice via a shape probe.
+template <bool kShortFast>
+void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
   size_t bytes = (size_t)(e - b);
   // worst-case bounds reserved once → raw unchecked cursor writes on the
   // whole hot path (untouched tail pages never fault): a feature token
@@ -1090,6 +1186,59 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
     while (true) {
       while (q < e && is_ws(*q)) ++q;
       if (q >= e || is_nl(*q)) break;  // end of row
+      // Fused fast path for the short binary-feature token class
+      // "d:d" / "dd:d" / "ddd:d" (the a1a shape: 1-3 digit index,
+      // single-digit value). The general path below discovers the
+      // index width through SEQUENTIAL data-dependent branches, which
+      // mispredict ~30% of tokens on mixed-width data (~15 cycles
+      // each — comparable to the whole token's useful work). Here the
+      // colon position is selected BRANCHLESSLY from one 8-byte load
+      // and a single combined-validity branch (that predicts
+      // overwhelmingly taken on this data class) commits the token.
+      // Any mismatch (wider index/value, floats, '+', qid, EOF edge)
+      // falls through to the general path untouched — byte parity is
+      // the general path's.
+      if (kShortFast && q + 3 < e) {
+        uint64_t w8 = load8(q, e);
+        unsigned b1 = (unsigned)(w8 >> 8) & 0xff;
+        unsigned b2 = (unsigned)(w8 >> 16) & 0xff;
+        unsigned b3 = (unsigned)(w8 >> 24) & 0xff;
+        unsigned d0 = ((unsigned)(w8)&0xff) - '0';
+        unsigned d1 = b1 - '0', d2 = b2 - '0', d3 = b3 - '0';
+        unsigned d4 = ((unsigned)(w8 >> 32) & 0xff) - '0';
+        bool v1 = (d0 <= 9) & (b1 == ':') & (d2 <= 9);
+        bool v2 = (d0 <= 9) & (d1 <= 9) & (b2 == ':') & (d3 <= 9);
+        bool v3 = (d0 <= 9) & (d1 <= 9) & (d2 <= 9) & (b3 == ':') &
+                  (d4 <= 9);
+        int p = v1 ? 1 : (v2 ? 2 : (v3 ? 3 : 0));
+        if (p) {
+          const char* tend = q + p + 2;
+          // byte after the token must be a separator/newline or the
+          // slice end (load8 zero-pads past e, so index via w8 only
+          // when tend < e)
+          char sep = (char)((w8 >> (8 * (p + 2))) & 0xff);
+          if (tend >= e || is_ws(sep) || is_nl(sep)) {
+            uint64_t idx = (p == 1) ? d0
+                           : (p == 2 ? d0 * 10 + d1
+                                     : d0 * 100 + d1 * 10 + d2);
+            float val = (float)((p == 1) ? d2 : (p == 2 ? d3 : d4));
+            if (!a->wide) {
+              DTP_DCHECK(ic < a->index32.data() + a->index32.cap);
+              *ic++ = (uint32_t)idx;
+            } else {
+              a->index32.n = (size_t)(ic - a->index32.data());
+              a->push_index(idx);
+              ic = a->index32.data() + a->index32.size();
+            }
+            DTP_DCHECK(vc < a->value.data() + a->value.cap);
+            *vc++ = val;
+            ++row_nnz;
+            seen_feature = true;
+            q = tend;
+            continue;
+          }
+        }
+      }
       const char* s = q;
       if (*s == '+') ++s;  // golden contract allows '+'
       const char* dstart = s;
@@ -1210,6 +1359,23 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
   if (!a->wide) a->index32.n = (size_t)(ic - a->index32.data());
   a->value.n = (size_t)(vc - a->value.data());
   AuditCursorBounds(*a);
+}
+
+void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
+  // Shape probe: average token length over the first line (or first
+  // 512 bytes) decides whether the fused short-token path pays for its
+  // per-token preamble. Both instantiations are byte-identical — the
+  // probe is purely a speed choice, re-made per slice.
+  const char* scan_end =
+      b + std::min((size_t)512, (size_t)(e - b));
+  const char* nl = b;
+  while (nl < scan_end && !is_nl(*nl)) ++nl;
+  int colons = 0;
+  for (const char* p = b; p < nl; ++p) colons += (*p == ':');
+  if (colons > 0 && (nl - b) / colons <= 8)
+    ParseLibSVMSliceImpl<true>(b, e, a);
+  else
+    ParseLibSVMSliceImpl<false>(b, e, a);
 }
 
 void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,

@@ -36,6 +36,32 @@ static std::string make_a1a(size_t target) {
   return s;
 }
 
+// fixed token shape ("j:1", 1-3 digit index), exactly k tokens per row:
+// two corpora with different k isolate per-ROW fixed cost from
+// per-TOKEN cost (VERDICT r3 #3 — label parse, offset write, row
+// turnaround), via t = rows*(B + k*T)
+static std::string make_rowlen(size_t target, int k) {
+  std::mt19937 rng(7);
+  std::string s;
+  s.reserve(target + 256);
+  std::uniform_int_distribution<int> idx(0, 122);
+  int i = 0;
+  while (s.size() < target) {
+    s += (i++ % 2) ? "1" : "-1";
+    int last = -1;
+    for (int t = 0; t < k; ++t) {
+      int j = idx(rng);
+      if (j <= last) j = (last + 1) % 123;
+      last = j;
+      s += ' ';
+      s += std::to_string(j);
+      s += ":1";
+    }
+    s += '\n';
+  }
+  return s;
+}
+
 static std::string make_criteo(size_t target) {
   std::mt19937 rng(1);
   std::string s;
@@ -116,7 +142,8 @@ static uint64_t digest(const CSRArena& a) {
 }
 
 template <typename F>
-static void run(const char* name, const std::string& data, int iters, F fn) {
+static double run(const char* name, const std::string& data, int iters,
+                  F fn) {
   CSRArena a;
   // warmup + digest
   fn(data.data(), data.data() + data.size(), &a);
@@ -133,6 +160,26 @@ static void run(const char* name, const std::string& data, int iters, F fn) {
   std::printf("%-22s %7.3f GB/s  (rows=%zu nnz=%zu digest=%016llx)\n", name,
               data.size() / best / 1e9, a.rows(), a.nnz(),
               (unsigned long long)d0);
+  return best / (double)a.rows();  // seconds per row
+}
+
+// per-row fixed-cost accounting (VERDICT r3 #3): same token shape,
+// rows of k1 vs k2 tokens; t/row = B + k*T solves for B (row
+// turnaround: label parse, offset write, loop resets) and T (token)
+static void row_cost_accounting(int iters, size_t mb) {
+  const int k1 = 2, k2 = 52;
+  std::string s1 = make_rowlen(mb << 20, k1);
+  std::string s2 = make_rowlen(mb << 20, k2);
+  auto parse = [](const char* b, const char* e, CSRArena* a) {
+    ParseLibSVMSlice(b, e, a);
+  };
+  double per_row1 = run("rowcost/k=2", s1, iters, parse);
+  double per_row2 = run("rowcost/k=52", s2, iters, parse);
+  double T = (per_row2 - per_row1) / (k2 - k1);
+  double B = per_row1 - k1 * T;
+  std::printf("row-cost fit: per-token %.1f ns, per-row fixed %.1f ns "
+              "(= %.1f token-equivalents)\n",
+              T * 1e9, B * 1e9, T > 0 ? B / T : 0.0);
 }
 
 int main(int argc, char** argv) {
@@ -164,5 +211,6 @@ int main(int argc, char** argv) {
         std::atomic<long> ncol(-1);
         ParseCSVSlice(b, e, cfg, &ncol, a);
       });
+  row_cost_accounting(iters, mb);
   return 0;
 }
