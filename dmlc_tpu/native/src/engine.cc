@@ -1116,11 +1116,26 @@ inline void CheckRowCursors(const CSRArena& a, const uint32_t* ic,
         "(token-size invariant violated; please report)"};
 }
 
+// THE fixed-6-decimal value classifier, shared by the kernel fast path
+// and the dispatcher probe so the two can never drift apart: vw is
+// load8(vb, e); true iff the value at vb is exactly "d.dddddd"
+// followed by a separator/newline or the slice end. (load8 zero-pads
+// past e, so a truncated tail fails the digit-run check on its own.)
+inline bool LooksFixed6(uint64_t vw, const char* vb, const char* e) {
+  unsigned f0 = ((unsigned)vw & 0xff) - '0';
+  if (f0 > 9 || ((vw >> 8) & 0xff) != '.') return false;
+  if (digit_run_len(vw >> 16) < 6) return false;  // bytes 2..7 digits
+  const char* vend = vb + 8;
+  return vend >= e || is_ws(*vend) || is_nl(*vend);
+}
+
 // parse [b, e) of whole text records into arena; throws EngineError.
 // kShortFast compiles in the fused short-token fast path — worth +27%
 // on the a1a shape class but a measured -13% tax on criteo-length
-// tokens, so the dispatcher below picks per slice via a shape probe.
-template <bool kShortFast>
+// tokens. kFixed6 compiles in the fused "d.dddddd" value path (the
+// %.6f export shape). The dispatcher below picks per slice via shape
+// probes; every variant is byte-identical.
+template <bool kShortFast, bool kFixed6>
 void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
   size_t bytes = (size_t)(e - b);
   // worst-case bounds reserved once → raw unchecked cursor writes on the
@@ -1308,23 +1323,43 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
       }
       const char* vb = ++s;
       float val;
+      bool val_done = false;
+      if (kFixed6) {
+        // fused "d.dddddd" value — the %.6f export shape (criteo-class
+        // data): one 8-byte load classifies the whole value, then ONE
+        // correctly-rounded IEEE division produces it. Parity with the
+        // strtod path is EXACT: d*10^6+frac is exact in double (< 2^24)
+        // and a single division of exact operands is correctly rounded
+        // — precisely the Clinger fast-path argument the general path
+        // relies on. Any other shape falls through untouched.
+        uint64_t vw = load8(vb, e);
+        if (LooksFixed6(vw, vb, e)) {
+          uint64_t x = (uint64_t)(((unsigned)vw & 0xff) - '0') * 1000000u +
+                       parse_digits_k(vw >> 16, 6);
+          val = (float)((double)x / 1e6);
+          s = vb + 8;
+          val_done = true;
+        }
+      }
       // single-digit values (":1" binary features) skip the general
       // float machinery — the dominant case in a1a-shaped data
-      unsigned vd0 = vb < e ? (unsigned)(vb[0] - '0') : 10u;
-      if (vd0 <= 9 && (vb + 1 == e || is_ws(vb[1]) || is_nl(vb[1]))) {
-        val = (float)vd0;
-        s = vb + 1;
-      } else {
-        double dval;
-        const char* vend = parse_f64_prefix(vb, e, &dval);
-        if (vend && (vend == e || is_ws(*vend) || is_nl(*vend))) {
-          val = (float)dval;
-          s = vend;
+      if (!val_done) {
+        unsigned vd0 = vb < e ? (unsigned)(vb[0] - '0') : 10u;
+        if (vd0 <= 9 && (vb + 1 == e || is_ws(vb[1]) || is_nl(vb[1]))) {
+          val = (float)vd0;
+          s = vb + 1;
         } else {
-          while (s < e && !is_ws(*s) && !is_nl(*s)) ++s;
-          if (!parse_f32(vb, s, &val))
-            throw EngineError{"libsvm: bad feature token '" +
-                              std::string(q, s) + "'"};
+          double dval;
+          const char* vend = parse_f64_prefix(vb, e, &dval);
+          if (vend && (vend == e || is_ws(*vend) || is_nl(*vend))) {
+            val = (float)dval;
+            s = vend;
+          } else {
+            while (s < e && !is_ws(*s) && !is_nl(*s)) ++s;
+            if (!parse_f32(vb, s, &val))
+              throw EngineError{"libsvm: bad feature token '" +
+                                std::string(q, s) + "'"};
+          }
         }
       }
       if (!a->wide && idx <= UINT32_MAX) {
@@ -1362,20 +1397,33 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
 }
 
 void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
-  // Shape probe: average token length over the first line (or first
-  // 512 bytes) decides whether the fused short-token path pays for its
-  // per-token preamble. Both instantiations are byte-identical — the
-  // probe is purely a speed choice, re-made per slice.
+  // Shape probes over the first line (or first 512 bytes) pick the
+  // kernel variant; all instantiations are byte-identical — the probe
+  // is purely a speed choice, re-made per slice. Probe 1: average
+  // token length <= 8 selects the fused short-token path. Probe 2:
+  // the first value looks like "d.dddddd" selects the fused
+  // fixed-6-decimal value path.
   const char* scan_end =
       b + std::min((size_t)512, (size_t)(e - b));
   const char* nl = b;
   while (nl < scan_end && !is_nl(*nl)) ++nl;
   int colons = 0;
   for (const char* p = b; p < nl; ++p) colons += (*p == ':');
-  if (colons > 0 && (nl - b) / colons <= 8)
-    ParseLibSVMSliceImpl<true>(b, e, a);
+  if (colons > 0 && (nl - b) / colons <= 8) {
+    ParseLibSVMSliceImpl<true, false>(b, e, a);
+    return;
+  }
+  const char* c1 = b;
+  while (c1 < nl && *c1 != ':') ++c1;
+  bool fixed6 = false;
+  if (c1 < nl) {
+    const char* vb = c1 + 1;
+    fixed6 = LooksFixed6(load8(vb, e), vb, e);
+  }
+  if (fixed6)
+    ParseLibSVMSliceImpl<false, true>(b, e, a);
   else
-    ParseLibSVMSliceImpl<false>(b, e, a);
+    ParseLibSVMSliceImpl<false, false>(b, e, a);
 }
 
 void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,

@@ -69,6 +69,60 @@ class TestEngineParity:
             c.push_block(parse_all(libsvm_file, "native", k, nparts))
         assert c.get_block().content_hash() == g.content_hash()
 
+    def test_libsvm_short_token_shape_parity(self, tmp_path, rng):
+        # r4: the fused short-token fast path ("d:d"/"dd:d"/"ddd:d",
+        # branchless colon-find) — parity over its boundary and
+        # FALLTHROUGH shapes: mixed 1-4 digit indices (4-digit falls to
+        # the general path), leading zeros, multi-digit/float/signed
+        # values, '+' prefixes, qid tokens, tokens abutting the slice
+        # end, CRLF, and blank lines
+        tok = ["7:1", "42:3", "122:9", "0:0", "00:1", "007:5",  # fused
+               "1234:1", "9:12", "3:1.5", "8:-1", "+55:2", "6:1e0"]
+        lines = []
+        for i in range(600):
+            n = rng.randint(1, 8)
+            toks = [tok[rng.randint(len(tok))] for _ in range(n)]
+            if i % 7 == 0:
+                toks.insert(0, f"qid:{i}")
+            lines.append(f"{(-1) ** i} " + " ".join(toks))
+        lines.append("1 55:7")    # token abuts EOF (no trailing sep)
+        body = "\n".join(lines) + "\n1 3:1\r\n\n1 2:2"
+        p = tmp_path / "short.libsvm"
+        p.write_bytes(body.encode())
+        g = parse_all(str(p), "python")
+        n = parse_all(str(p), "native")
+        assert g.content_hash() == n.content_hash()
+        # and sharded reads stitch to the same bytes
+        c = RowBlockContainer(np.uint32)
+        for k in range(3):
+            c.push_block(parse_all(str(p), "native", k, 3))
+        assert c.get_block().content_hash() == g.content_hash()
+
+    def test_libsvm_fixed6_value_shape_parity(self, tmp_path, rng):
+        # r4: the fused "d.dddddd" value path (%.6f export shape)
+        # computes the float as one exact-operand IEEE division; this
+        # pins byte parity with the python golden over the edge shapes
+        # AND over rows that mix matching and non-matching values (the
+        # per-token fallback inside the fixed6 kernel variant)
+        edge = ["0.000000", "9.999999", "1.000000", "0.000001",
+                "5.500000", "0.123456"]
+        other = ["10.123456", "0.12345", "0.1234567", "2", "3e-1",
+                 "0.123456e1", "-0.500000"]
+        lines = []
+        for i in range(400):
+            vals = [edge[rng.randint(len(edge))] for _ in range(5)]
+            if i % 3 == 0:  # mixed rows exercise the in-variant fallback
+                vals[rng.randint(5)] = other[rng.randint(len(other))]
+            feats = " ".join(f"{j * 7 + 3}:{v}" for j, v in enumerate(vals))
+            lines.append(f"{i % 2} {feats}")
+        # first line decides the probe: make it match fixed6
+        lines.insert(0, "1 3:0.654321 10:0.111111")
+        p = tmp_path / "f6.libsvm"
+        p.write_bytes(("\n".join(lines) + "\n").encode())
+        g = parse_all(str(p), "python")
+        n = parse_all(str(p), "native")
+        assert g.content_hash() == n.content_hash()
+
     def test_csv_parity(self, tmp_path, rng):
         rows = [",".join(f"{rng.randn():.7g}" for _ in range(8))
                 for _ in range(500)]
