@@ -331,11 +331,15 @@ inline bool parse_i64(const char* b, const char* e, int64_t* out) {
 // chunk then needs a FRESH arena), and first-touch faulting a fresh
 // multi-MB block costs ~1.5us per 4 KB page — measured 25-30% of the
 // whole a1a-shape parse (r4, BASELINE.md). Reusing WARM blocks across
-// arenas removes the faults. Pow2 size classes make hits likely across
-// equal-sized chunks; the cache is bounded (default 512 MB, env
-// DMLC_TPU_BLOCK_CACHE_MB, 0 disables) so RSS stays bounded — the soak
-// test pins that. Lock is per reserve/free (per-slice, off the token
-// hot path).
+// arenas removes the faults. Size classes are 2 MB-granular above 1 MB
+// (Buf::round_class — pow2 classes double when a worst-case reserve
+// bound lands just past a boundary); Get serves the smallest cached
+// block >= the request, so heterogeneous sizes cannot strand budget in
+// dead classes, and Put evicts smallest-first when over the cap (big
+// blocks serve the most requests under >=-matching). Bounded (default
+// 512 MB, env DMLC_TPU_BLOCK_CACHE_MB, 0 disables) so RSS stays
+// bounded — the soak test pins that. Lock is per reserve/free
+// (per-slice, off the token hot path).
 class BlockCache {
  public:
   static BlockCache& I() {
@@ -343,15 +347,19 @@ class BlockCache {
     return c;
   }
 
-  // pow2-rounded `bytes` (the caller's size class); nullptr on miss
-  void* Get(size_t bytes) {
+  // smallest cached block whose class >= bytes; {nullptr, 0} on miss.
+  // The returned class is the block's REAL capacity (may exceed the
+  // request) — the caller records it for the eventual Put.
+  std::pair<void*, size_t> Get(size_t bytes) {
     std::lock_guard<std::mutex> g(mu_);
-    auto it = free_.find(bytes);
-    if (it == free_.end() || it->second.empty()) return nullptr;
+    auto it = free_.lower_bound(bytes);
+    if (it == free_.end()) return {nullptr, 0};
     void* p = it->second.back();
     it->second.pop_back();
-    held_ -= bytes;
-    return p;
+    size_t cls = it->first;
+    if (it->second.empty()) free_.erase(it);
+    held_ -= cls;
+    return {p, cls};
   }
 
   // true = cache took ownership; false = caller frees. Called from
@@ -360,8 +368,15 @@ class BlockCache {
   // never as an exception escaping a destructor.
   bool Put(void* p, size_t bytes) {
     std::lock_guard<std::mutex> g(mu_);
-    if (held_ + bytes > cap_) return false;
     try {
+      while (held_ + bytes > cap_ && !free_.empty()) {
+        auto it = free_.begin();  // evict smallest class first
+        ::operator delete(it->second.back());
+        it->second.pop_back();
+        held_ -= it->first;
+        if (it->second.empty()) free_.erase(it);
+      }
+      if (held_ + bytes > cap_) return false;
       free_[bytes].push_back(p);
     } catch (...) {
       return false;
@@ -380,7 +395,7 @@ class BlockCache {
       for (void* p : kv.second) ::operator delete(p);
   }
   std::mutex mu_;
-  std::unordered_map<size_t, std::vector<void*>> free_;
+  std::map<size_t, std::vector<void*>> free_;  // ordered: >=-matching
   size_t held_ = 0;
   size_t cap_ = (size_t)512 << 20;
 };
@@ -397,17 +412,27 @@ struct Buf {
   static constexpr size_t kCacheMin = (size_t)1 << 20;
   T* d = nullptr;
   size_t n = 0, cap = 0;
-  size_t alloc_bytes = 0;  // pow2 size class of d (0 = plain new)
+  size_t alloc_bytes = 0;  // real byte capacity / cache class of d
 
   Buf() = default;
   Buf(const Buf&) = delete;
   Buf& operator=(const Buf&) = delete;
   ~Buf() { release_block(); }
 
-  static size_t round_pow2(size_t v) {
-    size_t p = 4096;
-    while (p < v) p <<= 1;
-    return p;
+  static size_t round_class(size_t v) {
+    // below the cache threshold: pow2 (growth amortization only).
+    // Cacheable sizes: 2 MB-granular classes — the worst-case reserve
+    // bounds land "just over" pow2 boundaries (e.g. (bytes/2+2)*8 =
+    // 32 MB + 16), and pow2 rounding would DOUBLE the class, blowing
+    // the cache budget and re-introducing the faults the cache exists
+    // to remove.
+    if (v < kCacheMin) {
+      size_t p = 4096;
+      while (p < v) p <<= 1;
+      return p;
+    }
+    const size_t g = (size_t)2 << 20;
+    return (v + g - 1) / g * g;
   }
 
   void release_block() {
@@ -425,10 +450,15 @@ struct Buf {
   void reserve(size_t want) {
     if (want <= cap) return;
     size_t ncap = std::max(want, cap * 2);
-    size_t bytes = round_pow2(ncap * sizeof(T));
+    size_t bytes = round_class(ncap * sizeof(T));
     T* nd = nullptr;
-    if (bytes >= kCacheMin)
-      nd = static_cast<T*>(BlockCache::I().Get(bytes));
+    if (bytes >= kCacheMin) {
+      auto [p, cls] = BlockCache::I().Get(bytes);
+      if (p) {
+        nd = static_cast<T*>(p);
+        bytes = cls;  // the served block may be larger than asked
+      }
+    }
     if (!nd) nd = static_cast<T*>(::operator new(bytes));
     if (n) std::memcpy(nd, d, n * sizeof(T));
     release_block();  // resets d/cap/alloc_bytes only; n is preserved

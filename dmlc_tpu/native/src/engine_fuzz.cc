@@ -33,6 +33,40 @@ std::string make_libsvm(int rows) {
   return out;
 }
 
+// shaped so the dispatcher's probes select the r4 fused kernel
+// variants (short-token / fixed-6-decimal): mutations then hammer the
+// SWAR classification + fallthrough seams under ASAN; a mutated first
+// line can flip the probe, fuzzing the variant boundary itself
+std::string make_libsvm_short(int rows) {
+  std::string out;
+  char buf[32];
+  for (int i = 0; i < rows; ++i) {
+    out += (i % 2) ? "1" : "-1";
+    for (int f = (int)(g_rng() % 10); f >= 0; --f) {
+      snprintf(buf, sizeof buf, " %d:%d", (int)(g_rng() % 1000),
+               (int)(g_rng() % 10));
+      out += buf;
+    }
+    out += "\n";
+  }
+  return out;
+}
+
+std::string make_libsvm_fixed6(int rows) {
+  std::string out;
+  char buf[48];
+  for (int i = 0; i < rows; ++i) {
+    out += (i % 2) ? "1" : "0";
+    for (int f = (int)(g_rng() % 8); f >= 0; --f) {
+      snprintf(buf, sizeof buf, " %d:%d.%06d", (int)(g_rng() % 100000),
+               (int)(g_rng() % 10), (int)(g_rng() % 1000000));
+      out += buf;
+    }
+    out += "\n";
+  }
+  return out;
+}
+
 std::string make_libfm(int rows) {
   std::string out;
   char buf[64];
@@ -234,11 +268,16 @@ int main(int argc, char** argv) {
   int t3 = fuzz_text(Format::kLibFM, fm, iters);
   int t4 = fuzz_recordio(rec, iters);
   int t5 = fuzz_recidx(rec, frames, iters);
+  // r4 fused kernel variants (shape-probed): corrupted short-token and
+  // fixed-6-decimal corpora drive the SWAR paths and their fallthrough
+  int t6 = fuzz_text(Format::kLibSVM, make_libsvm_short(60), iters);
+  int t7 = fuzz_text(Format::kLibSVM, make_libsvm_fixed6(60), iters);
   // sanity: the corrupting fuzz must actually hit rejection paths
   std::printf("fuzz complete: rejects libsvm=%d csv=%d libfm=%d "
-              "recordio=%d recidx=%d of %d each\n", t1, t2, t3, t4, t5,
-              iters);
-  if (t1 == 0 || t2 == 0 || t3 == 0 || t4 == 0 || t5 <= 0) {
+              "recordio=%d recidx=%d short=%d fixed6=%d of %d each\n",
+              t1, t2, t3, t4, t5, t6, t7, iters);
+  if (t1 == 0 || t2 == 0 || t3 == 0 || t4 == 0 || t5 <= 0 || t6 == 0 ||
+      t7 == 0) {
     std::fprintf(stderr, "fuzz too weak: no rejections seen\n");
     return 1;
   }
