@@ -7,10 +7,14 @@ flagship: the logistic-regression core of the linear XGBoost booster
 family, consuming exactly the sharded batch layout dmlc_tpu.parallel
 produces. SparseFMModel (second-order FM) and SparseFFMModel (field-aware,
 consuming the libfm field[] column) are the
-canonical consumers of the libfm format family.
+canonical consumers of the libfm format family. SparseRankingModel
+(pairwise RankNet loss) consumes the libsvm qid column — with it,
+every parsed column has a device consumer.
 """
 
 from dmlc_tpu.models.fm import SparseFFMModel, SparseFMModel
 from dmlc_tpu.models.linear import SparseLinearModel
+from dmlc_tpu.models.ranking import SparseRankingModel
 
-__all__ = ["SparseLinearModel", "SparseFMModel", "SparseFFMModel"]
+__all__ = ["SparseLinearModel", "SparseFMModel", "SparseFFMModel",
+           "SparseRankingModel"]

@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dmlc_tpu.models.common import stable_bce_on_logits
+from dmlc_tpu.models.common import (SparseModelBase,
+                                    stable_bce_on_logits)
 from dmlc_tpu.ops.csr import csr_row_ids, segment_spmv, segment_sum
 
 __all__ = ["SparseFMModel", "SparseFFMModel"]
@@ -52,18 +53,14 @@ def _fm_margins(w, b, V, offset, index, value, num_rows: int):
     return linear + 0.5 * jnp.sum(s * s - sq, axis=-1) + b
 
 
-class _SparseFactorModelBase:
-    """Shared logistic-loss SGD scaffolding for the factor models.
-
-    Subclasses provide ``init_params`` and ``_margins(params, flat_batch,
-    num_rows)`` plus ``_BATCH_KEYS`` (the CSR columns the margins
-    consume). Everything else — weighted BCE loss, l2, the jitted SGD
-    step, the shard_map global loss (batch columns sharded on the data
-    axis, params replicated), and inference — is defined ONCE here, so a
-    fix to the scaffolding cannot silently diverge between FM and FFM
+class _SparseFactorModelBase(SparseModelBase):
+    """Factor-model layer over the shared scaffolding: subclasses
+    provide ``init_params`` and ``_margins(params, flat_batch,
+    num_rows)`` (plus ``_BATCH_KEYS`` when the margins consume extra
+    columns); the weighted-BCE objective, SGD step, shard_map global
+    loss, and l2 all come from models.common.SparseModelBase — defined
+    ONCE so a scaffolding fix cannot silently diverge between models
     (review r4)."""
-
-    _BATCH_KEYS: tuple = ("offset", "index", "value")
 
     # -- subclass surface
 
@@ -71,73 +68,18 @@ class _SparseFactorModelBase:
                  num_rows: int) -> jnp.ndarray:
         raise NotImplementedError
 
-    # -- single-chip path (flat padded batch)
+    # -- objective hook (flat and shard_map paths both land here)
+
+    def _block_objective(self, params, flat, num_rows: int):
+        per_row = stable_bce_on_logits(
+            self._margins(params, flat, num_rows), flat["label"])
+        w = flat["weight"]
+        return jnp.sum(per_row * w), jnp.sum(w)
 
     def forward(self, params: Dict[str, Any],
                 batch: Dict[str, Any]) -> jnp.ndarray:
         return self._margins(params, batch,
                              num_rows=batch["label"].shape[0])
-
-    def _l2_term(self, params: Dict[str, Any]) -> jnp.ndarray:
-        return jnp.sum(params["w"] ** 2) + jnp.sum(params["V"] ** 2)
-
-    def loss(self, params: Dict[str, Any],
-             batch: Dict[str, Any]) -> jnp.ndarray:
-        per_row = stable_bce_on_logits(self.forward(params, batch),
-                                       batch["label"])
-        w = batch["weight"]
-        loss = jnp.sum(per_row * w) / jnp.maximum(jnp.sum(w), 1.0)
-        if self.l2:
-            loss = loss + self.l2 * self._l2_term(params)
-        return loss
-
-    @partial(jax.jit, static_argnums=0)
-    def train_step(self, params, batch):
-        loss, grads = jax.value_and_grad(self.loss)(params, batch)
-        new_params = jax.tree.map(
-            lambda p, g: p - self.learning_rate * g, params, grads)
-        return new_params, loss
-
-    # -- multi-chip path (global [D, ...] batches, shard_map over 'data')
-
-    def global_loss_fn(self, mesh: Mesh, axis: str = "data"):
-        keys = self._BATCH_KEYS + ("label", "weight")
-
-        def _block_loss(params, blk):
-            row_bucket = blk["label"].shape[1]
-            flat = {k: v[0] for k, v in blk.items()}
-            margins = self._margins(params, flat, num_rows=row_bucket)
-            per_row = stable_bce_on_logits(margins, flat["label"])
-            lsum = jax.lax.psum(jnp.sum(per_row * flat["weight"]), axis)
-            wsum = jax.lax.psum(jnp.sum(flat["weight"]), axis)
-            return lsum / jnp.maximum(wsum, 1.0)
-
-        from jax import shard_map
-        # P() is a tree PREFIX covering the whole params dict; batch
-        # columns shard on the data axis
-        smapped = shard_map(
-            _block_loss, mesh=mesh,
-            in_specs=(P(), {k: P(axis) for k in keys}),
-            out_specs=P())
-
-        def loss(params, batch):
-            base = smapped(params, {k: batch[k] for k in keys})
-            if self.l2:
-                base = base + self.l2 * self._l2_term(params)
-            return base
-        return loss
-
-    def make_sharded_train_step(self, mesh: Mesh, axis: str = "data"):
-        loss_fn = self.global_loss_fn(mesh, axis)
-        replicated = NamedSharding(mesh, P())
-
-        @partial(jax.jit, out_shardings=(replicated, replicated))
-        def step(params, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            new_params = jax.tree.map(
-                lambda p, g: p - self.learning_rate * g, params, grads)
-            return new_params, loss
-        return step
 
     # -- inference
 

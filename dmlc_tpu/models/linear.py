@@ -10,26 +10,25 @@ TPU consumer of the framework's data layout:
   axis the reference's world has (SURVEY.md §2.4: no TP/PP/SP/EP exists
   to mirror; data sharding IS dmlc-core's distributed model).
 
-Padded rows carry weight 0, so they are loss- and gradient-neutral.
+Padded rows carry weight 0, so they are loss- and gradient-neutral. The
+SGD/shard_map scaffolding lives ONCE in models.common.SparseModelBase
+(shared with the FM/FFM/ranking models — review r4).
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dmlc_tpu.models.common import stable_bce_on_logits
+from dmlc_tpu.models.common import SparseModelBase, stable_bce_on_logits
 from dmlc_tpu.ops.csr import segment_spmv
 
 __all__ = ["SparseLinearModel"]
 
 
-class SparseLinearModel:
+class SparseLinearModel(SparseModelBase):
     """Logistic regression on sparse CSR batches.
 
     Labels are mapped to {0, 1} via (label > 0) — accepts the ±1
@@ -47,8 +46,6 @@ class SparseLinearModel:
         return {"w": jnp.zeros((self.num_features,), jnp.float32),
                 "b": jnp.zeros((), jnp.float32)}
 
-    # -- single-chip path (flat padded batch)
-
     def forward(self, params: Dict[str, Any],
                 batch: Dict[str, Any]) -> jnp.ndarray:
         """Margins for one flat padded CSR batch."""
@@ -58,66 +55,13 @@ class SparseLinearModel:
                                num_rows=num_rows)
         return margins + params["b"]
 
-    def loss(self, params: Dict[str, Any],
-             batch: Dict[str, Any]) -> jnp.ndarray:
-        """Weighted BCE over real rows (padded rows have weight 0)."""
-        per_row = stable_bce_on_logits(self.forward(params, batch),
-                                       batch["label"])
-        w = batch["weight"]
-        loss = jnp.sum(per_row * w) / jnp.maximum(jnp.sum(w), 1.0)
-        if self.l2:
-            loss = loss + self.l2 * jnp.sum(params["w"] ** 2)
-        return loss
-
-    @partial(jax.jit, static_argnums=0)
-    def train_step(self, params, batch):
-        loss, grads = jax.value_and_grad(self.loss)(params, batch)
-        new_params = jax.tree.map(
-            lambda p, g: p - self.learning_rate * g, params, grads)
-        return new_params, loss
-
-    # -- multi-chip path (global [D, ...] batches, shard_map over 'data')
-
-    def global_loss_fn(self, mesh: Mesh, axis: str = "data"):
-        """Returns loss(params, batch) over a global sharded batch."""
-        def _block_loss(w, b, offset, index, value, label, weight):
-            # inside shard_map: leading dim is this device's single block
-            row_bucket = label.shape[1]
-            margins = segment_spmv(offset[0], index[0], value[0], w,
-                                   num_rows=row_bucket) + b
-            per_row = stable_bce_on_logits(margins, label[0])
-            lsum = jax.lax.psum(jnp.sum(per_row * weight[0]), axis)
-            wsum = jax.lax.psum(jnp.sum(weight[0]), axis)
-            return lsum / jnp.maximum(wsum, 1.0)
-
-        from jax import shard_map
-        smapped = shard_map(
-            _block_loss, mesh=mesh,
-            in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis)),
-            out_specs=P())
-
-        def loss(params, batch):
-            base = smapped(params["w"], params["b"], batch["offset"],
-                           batch["index"], batch["value"], batch["label"],
-                           batch["weight"])
-            if self.l2:
-                base = base + self.l2 * jnp.sum(params["w"] ** 2)
-            return base
-        return loss
-
-    def make_sharded_train_step(self, mesh: Mesh, axis: str = "data"):
-        """jitted (params, global_batch) -> (params, loss); params
-        replicated, batch sharded on the data axis."""
-        loss_fn = self.global_loss_fn(mesh, axis)
-        replicated = NamedSharding(mesh, P())
-
-        @partial(jax.jit, out_shardings=(replicated, replicated))
-        def step(params, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            new_params = jax.tree.map(
-                lambda p, g: p - self.learning_rate * g, params, grads)
-            return new_params, loss
-        return step
+    def _block_objective(self, params, flat, num_rows: int):
+        margins = segment_spmv(flat["offset"], flat["index"],
+                               flat["value"], params["w"],
+                               num_rows=num_rows) + params["b"]
+        per_row = stable_bce_on_logits(margins, flat["label"])
+        w = flat["weight"]
+        return jnp.sum(per_row * w), jnp.sum(w)
 
     # -- inference helpers
 

@@ -1,0 +1,147 @@
+"""Pairwise ranking (RankNet-style) over sparse CSR batches — the
+consumer of the libsvm ``qid`` column.
+
+Reference: src/data/libsvm_parser.h parses ``qid:`` tokens into
+RowBlock.qid; rank:pairwise is the XGBoost-family objective that column
+exists to feed (dmlc-core itself ships no models). With this, every
+column the parsers fill — label, weight, index, value, field, qid —
+has a device consumer (field: models.fm.SparseFFMModel).
+
+Math: scores m_i = w·x_i + b; for documents i, j of the SAME query with
+label_i > label_j, the pairwise logistic loss softplus(-(m_i - m_j)),
+weighted by weight_i * weight_j, averaged over pairs. TPU-first shape:
+the padded batch's qid column (pad -1) builds an [n, n] pair mask
+(same-qid AND label_i > label_j AND both valid); the loss is the masked
+mean — O(row_bucket²) elementwise on the VPU, static shapes, no
+sorting, no dynamic pair lists. Padded rows are doubly neutral (qid -1
+never matches a real qid; weight 0 zeroes the pair weight).
+
+SIZING: the pair mask is O(row_bucket²) memory — several [n, n] f32
+intermediates live at once under value_and_grad. Ranking batches must
+therefore use MODEST row buckets (e.g. ShardedRowBlockIter(...,
+row_bucket=1024); the iterator's 1<<14 default would make each
+intermediate ~1 GiB). The constructor's ``max_row_bucket`` (default
+4096 ≈ 64 MB per intermediate) turns an oversized batch into a loud
+trace-time error instead of an OOM.
+
+Sharding: under shard_map over the 'data' axis, pairs form WITHIN each
+device's block and the (pair-loss, pair-count) sums are psum'd. A qid
+group that straddles a shard boundary contributes only its within-shard
+pairs — the standard practical approximation for sharded pairwise
+ranking; qid-grouped files (the libsvm ranking convention keeps a
+query's rows contiguous) mostly land whole groups in one shard. The
+flat single-chip path forms ALL pairs, so sharded == flat holds exactly
+when groups do not straddle (the test constructs that case).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from dmlc_tpu.models.common import SparseModelBase
+from dmlc_tpu.ops.csr import segment_spmv
+from dmlc_tpu.utils.logging import DMLCError
+
+__all__ = ["SparseRankingModel"]
+
+
+def _pair_weights(label, qid, weight):
+    """[n, n] preference-pair weights — the ONE definition of which
+    pairs exist and what they weigh, shared by the training objective
+    AND pairwise_accuracy: pw[i, j] = w_i * w_j where qid_i == qid_j
+    (both valid, pad -1 never matches) and label_i > label_j; else 0."""
+    valid = qid >= 0
+    same = ((qid[:, None] == qid[None, :])
+            & valid[:, None] & valid[None, :])
+    pref = label[:, None] > label[None, :]
+    return (weight[:, None] * weight[None, :]
+            * (same & pref).astype(jnp.float32))
+
+
+def _pair_sums(margins, label, qid, weight):
+    """(Σ pair losses, Σ pair weights) for one flat block."""
+    pw = _pair_weights(label, qid, weight)
+    diff = margins[:, None] - margins[None, :]
+    return jnp.sum(jax.nn.softplus(-diff) * pw), jnp.sum(pw)
+
+
+class SparseRankingModel(SparseModelBase):
+    """Linear scorer + pairwise logistic (RankNet) loss.
+
+    Batches must carry ``qid`` (the libsvm parser fills it and
+    pad_to_bucket forwards it with -1 padding). Scaffolding (SGD step,
+    shard_map global loss, l2) comes from models.common.SparseModelBase."""
+
+    _BATCH_KEYS = ("offset", "index", "value", "qid")
+
+    def __init__(self, num_features: int, l2: float = 0.0,
+                 learning_rate: float = 0.1,
+                 max_row_bucket: int = 4096):
+        self.num_features = num_features
+        self.l2 = l2
+        self.learning_rate = learning_rate
+        self.max_row_bucket = max_row_bucket
+
+    def init_params(self, seed: int = 0) -> Dict[str, jnp.ndarray]:
+        del seed  # a zero-init linear scorer has no symmetry to break
+        return {"w": jnp.zeros((self.num_features,), jnp.float32),
+                "b": jnp.zeros((), jnp.float32)}
+
+    @staticmethod
+    def validate_batch(batch: Dict[str, Any]) -> None:
+        """Host-side guard: the batch must carry a ``qid`` column (the
+        libsvm parser fills it only when the file has qid: tokens;
+        pad_to_bucket forwards it only when present). Without this, a
+        qid-less data source would surface as a bare KeyError deep in
+        a jit trace."""
+        from dmlc_tpu.utils.logging import check
+        check("qid" in batch,
+              "SparseRankingModel needs a 'qid' batch column but the "
+              "batch has none — the source data has no qid: tokens "
+              "(pairwise ranking is undefined without query groups)")
+
+    def forward(self, params: Dict[str, Any],
+                batch: Dict[str, Any]) -> jnp.ndarray:
+        return segment_spmv(batch["offset"], batch["index"],
+                            batch["value"], params["w"],
+                            num_rows=batch["label"].shape[0]) + params["b"]
+
+    def _block_objective(self, params, flat, num_rows: int):
+        if "qid" not in flat:
+            # raises at TRACE time with the real cause, not KeyError
+            self.validate_batch(flat)
+        if num_rows > self.max_row_bucket:
+            # shapes are static under jit, so this raises at TRACE time
+            # — a loud sizing error instead of an [n, n] OOM on device
+            raise DMLCError(
+                f"SparseRankingModel: row bucket {num_rows} exceeds "
+                f"max_row_bucket={self.max_row_bucket} — the pairwise "
+                "loss materializes [n, n] intermediates "
+                f"(~{num_rows * num_rows * 4 / 1e9:.1f} GB each here); "
+                "use a smaller row_bucket in the batch iterator, or "
+                "raise max_row_bucket explicitly if the memory budget "
+                "allows")
+        margins = segment_spmv(flat["offset"], flat["index"],
+                               flat["value"], params["w"],
+                               num_rows=num_rows) + params["b"]
+        return _pair_sums(margins, flat["label"], flat["qid"],
+                          flat["weight"])
+
+    # -- evaluation
+
+    def pairwise_accuracy(self, params, batch) -> float:
+        """Fraction of preference pairs the scorer orders correctly
+        (host-side; strict inequality, ties count as wrong). Pair
+        semantics come from the SAME _pair_weights the loss uses."""
+        import numpy as np
+        self.validate_batch(batch)
+        m = np.asarray(self.forward(params, batch))
+        pw = np.asarray(_pair_weights(jnp.asarray(batch["label"]),
+                                      jnp.asarray(batch["qid"]),
+                                      jnp.asarray(batch["weight"])))
+        correct = (m[:, None] > m[None, :]) * pw
+        total = pw.sum()
+        return float(correct.sum() / total) if total > 0 else float("nan")
