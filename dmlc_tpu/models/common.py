@@ -62,8 +62,22 @@ class SparseModelBase:
     def _l2_term(self, params: Dict[str, Any]) -> jnp.ndarray:
         return sum(jnp.sum(v ** 2) for k, v in params.items() if k != "b")
 
+    def _check_columns(self, batch: Dict[str, Any]) -> None:
+        """Named error for a batch missing columns this model's
+        objective consumes (e.g. a qid-less source feeding the ranking
+        model) — instead of a bare KeyError deep in a jit trace."""
+        from dmlc_tpu.utils.logging import check
+        missing = [k for k in self._BATCH_KEYS + ("label", "weight")
+                   if k not in batch]
+        check(not missing,
+              f"{type(self).__name__} needs batch column(s) {missing} "
+              "that this batch lacks — the source data has no such "
+              "column (e.g. no qid:/field tokens), or the padding layer "
+              "dropped it")
+
     def loss(self, params: Dict[str, Any],
              batch: Dict[str, Any]) -> jnp.ndarray:
+        self._check_columns(batch)
         lsum, wsum = self._block_objective(
             params, batch, num_rows=batch["label"].shape[0])
         loss = _weighted_mean(lsum, wsum)
@@ -99,6 +113,7 @@ class SparseModelBase:
             out_specs=P())
 
         def loss(params, batch):
+            self._check_columns(batch)
             base = smapped(params, {k: batch[k] for k in keys})
             if self.l2:
                 base = base + self.l2 * self._l2_term(params)

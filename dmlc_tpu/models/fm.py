@@ -28,12 +28,10 @@ padded nnz tail carries value 0 and contributes nothing.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.models.common import (SparseModelBase,
                                     stable_bce_on_logits)

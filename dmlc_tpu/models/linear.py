@@ -56,10 +56,9 @@ class SparseLinearModel(SparseModelBase):
         return margins + params["b"]
 
     def _block_objective(self, params, flat, num_rows: int):
-        margins = segment_spmv(flat["offset"], flat["index"],
-                               flat["value"], params["w"],
-                               num_rows=num_rows) + params["b"]
-        per_row = stable_bce_on_logits(margins, flat["label"])
+        del num_rows  # forward derives it from flat["label"]
+        per_row = stable_bce_on_logits(self.forward(params, flat),
+                                       flat["label"])
         w = flat["weight"]
         return jnp.sum(per_row * w), jnp.sum(w)
 

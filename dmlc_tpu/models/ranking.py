@@ -110,9 +110,6 @@ class SparseRankingModel(SparseModelBase):
                             num_rows=batch["label"].shape[0]) + params["b"]
 
     def _block_objective(self, params, flat, num_rows: int):
-        if "qid" not in flat:
-            # raises at TRACE time with the real cause, not KeyError
-            self.validate_batch(flat)
         if num_rows > self.max_row_bucket:
             # shapes are static under jit, so this raises at TRACE time
             # — a loud sizing error instead of an [n, n] OOM on device
@@ -124,9 +121,7 @@ class SparseRankingModel(SparseModelBase):
                 "use a smaller row_bucket in the batch iterator, or "
                 "raise max_row_bucket explicitly if the memory budget "
                 "allows")
-        margins = segment_spmv(flat["offset"], flat["index"],
-                               flat["value"], params["w"],
-                               num_rows=num_rows) + params["b"]
+        margins = self.forward(params, flat)  # ONE margin definition
         return _pair_sums(margins, flat["label"], flat["qid"],
                           flat["weight"])
 

@@ -42,6 +42,11 @@ class TestExamples:
         assert r.returncode == 0, r.stderr[-3000:]
         assert "OK" in r.stdout
 
+    def test_train_ranking(self):
+        r = _run("train_ranking.py")
+        assert r.returncode == 0, r.stderr[-3000:]
+        assert "OK" in r.stdout
+
     def test_tpu_device_ingest(self):
         r = _run("tpu_device_ingest.py")
         assert r.returncode == 0, r.stderr[-3000:]

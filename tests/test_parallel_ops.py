@@ -302,7 +302,9 @@ class TestSparseRankingModel:
                     pw = wt[i] * wt[j]
                     num += pw * np.log1p(np.exp(-(m[i] - m[j])))
                     den += pw
-        return num / max(den, 1.0)
+        # true weighted mean (the production max(den, 1) clamp was
+        # removed in r4; den == 0 means no pairs)
+        return num / den if den > 0 else 0.0
 
     def test_loss_matches_brute_force(self, rng):
         from dmlc_tpu.models import SparseRankingModel
@@ -362,9 +364,10 @@ class TestSparseRankingModel:
         np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
                                    rtol=1e-4, atol=1e-6)
 
-    def test_missing_qid_raises_named_error(self, rng):
+    def test_missing_qid_raises_named_error(self, mesh, rng):
         # a qid-less batch must fail with the real cause, not a bare
-        # KeyError inside a jit trace
+        # KeyError inside a jit trace — on BOTH the flat and the
+        # sharded path
         from dmlc_tpu.models import SparseRankingModel
         from dmlc_tpu.utils.logging import DMLCError
         block = random_block(rng, rows=8)
@@ -372,6 +375,11 @@ class TestSparseRankingModel:
         model = SparseRankingModel(50)
         with pytest.raises(DMLCError, match="qid"):
             model.loss(model.init_params(), batch)
+        locals_ = [pad_to_bucket(random_block(rng, rows=4), 8, 64)
+                   for _ in range(8)]
+        gb = make_global_batch(stack_device_batches(locals_), mesh)
+        with pytest.raises(DMLCError, match="qid"):
+            model.make_sharded_train_step(mesh)(model.init_params(), gb)
 
     def test_sub_unit_weights_use_true_weighted_mean(self, rng):
         # pair weights are PRODUCTS of instance weights: with weights
