@@ -90,18 +90,12 @@ class SparseRankingModel(SparseModelBase):
         return {"w": jnp.zeros((self.num_features,), jnp.float32),
                 "b": jnp.zeros((), jnp.float32)}
 
-    @staticmethod
-    def validate_batch(batch: Dict[str, Any]) -> None:
-        """Host-side guard: the batch must carry a ``qid`` column (the
-        libsvm parser fills it only when the file has qid: tokens;
-        pad_to_bucket forwards it only when present). Without this, a
-        qid-less data source would surface as a bare KeyError deep in
-        a jit trace."""
-        from dmlc_tpu.utils.logging import check
-        check("qid" in batch,
-              "SparseRankingModel needs a 'qid' batch column but the "
-              "batch has none — the source data has no qid: tokens "
-              "(pairwise ranking is undefined without query groups)")
+    def validate_batch(self, batch: Dict[str, Any]) -> None:
+        """Host-side guard: the batch must carry every column the
+        objective consumes — notably ``qid`` (the libsvm parser fills
+        it only when the file has qid: tokens). Delegates to the shared
+        column check so the requirement is stated once."""
+        self._check_columns(batch)
 
     def forward(self, params: Dict[str, Any],
                 batch: Dict[str, Any]) -> jnp.ndarray:

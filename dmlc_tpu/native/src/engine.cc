@@ -1456,14 +1456,41 @@ void ParseLibSVMSlice(const char* b, const char* e, CSRArena* a) {
     ParseLibSVMSliceImpl<false, false>(b, e, a);
 }
 
-void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
-                   std::atomic<long>* ncol_atom, CSRArena* a) {
+// THE rule for whether a delimiter can appear inside a decimal number:
+// when it can, the fused/fast cell parses must never pick cell
+// boundaries themselves. Shared by the ParseCSVSlice dispatcher and
+// the Impl so the two gates cannot drift (review r4).
+inline bool DelimiterFastOk(char d) {
+  return !(d == '.' || d == '+' || d == '-' || d == 'e' || d == 'E' ||
+           (d >= '0' && d <= '9') || is_ws(d) || is_nl(d));
+}
+
+// The fixed-6-decimal CELL classifier (csv flavor of LooksFixed6): the
+// terminator after "d.dddddd" is the delimiter or a newline, not ws.
+inline bool LooksFixed6Cell(uint64_t vw, const char* vb, const char* e,
+                            char delim) {
+  unsigned f0 = ((unsigned)vw & 0xff) - '0';
+  if (f0 > 9 || ((vw >> 8) & 0xff) != '.') return false;
+  if (digit_run_len(vw >> 16) < 6) return false;  // bytes 2..7 digits
+  const char* vend = vb + 8;
+  return vend >= e || *vend == delim || is_nl(*vend);
+}
+
+// kFixed6 compiles in the fused "d.dddddd" cell path (the %.6f export
+// shape — HIGGS-class data): one 8-byte classification + one
+// exact-operand IEEE division, byte parity with the strtod path exact
+// by the same Clinger argument as the libsvm variant. Selected per
+// slice by the dispatcher's probe; requires fast_ok (a delimiter that
+// can appear inside a decimal must never let the fused path pick the
+// cell boundary).
+template <bool kFixed6>
+void ParseCSVSliceImpl(const char* b, const char* e,
+                       const ParserConfig& cfg,
+                       std::atomic<long>* ncol_atom, CSRArena* a) {
   // the fused prefix parse may only delimit cells itself when the
   // delimiter cannot appear inside a decimal
   const char d = cfg.delimiter;
-  const bool fast_ok = !(d == '.' || d == '+' || d == '-' || d == 'e' ||
-                         d == 'E' || (d >= '0' && d <= '9') || is_ws(d) ||
-                         is_nl(d));
+  const bool fast_ok = DelimiterFastOk(d);
   // hot per-cell buffers: worst-case bound (a feature cell is >=2 bytes
   // incl. delimiter, "0,") reserved once so the loop writes through raw
   // cursors with no per-push capacity check (same pattern as libsvm);
@@ -1495,6 +1522,17 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
       // tolerate surrounding whitespace in cells (golden: Python float())
       const char* vb = cell;
       while (vb < e && is_ws(*vb)) ++vb;
+      if (kFixed6) {
+        uint64_t vw = load8(vb, e);
+        if (LooksFixed6Cell(vw, vb, e, d)) {
+          uint64_t x = (uint64_t)(((unsigned)vw & 0xff) - '0') * 1000000u +
+                       parse_digits_k(vw >> 16, 6);
+          v = (float)((double)x / 1e6);
+          cell_end = vb + 8;
+          goto cell_parsed;
+        }
+      }
+      {
       double dv;
       const char* pend = fast_ok ? parse_f64_prefix(vb, e, &dv) : nullptr;
       if (pend) {
@@ -1519,6 +1557,8 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
           throw EngineError{"csv: bad value '" +
                             std::string(cell, cell_end) + "'"};
       }
+      }
+    cell_parsed:
       if (col == cfg.label_column) {
         label = v;
       } else if (col == cfg.weight_column) {
@@ -1572,6 +1612,31 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
   a->index32.n = (size_t)(ic - a->index32.data());  // csv never widens
   a->value.n = (size_t)(vc - a->value.data());
   AuditCursorBounds(*a);
+}
+
+void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
+                   std::atomic<long>* ncol_atom, CSRArena* a) {
+  // Shape probe (csv flavor of the libsvm dispatcher): the cell after
+  // the first delimiter of the first line looking like "d.dddddd"
+  // selects the fused fixed-6-decimal variant. Both instantiations are
+  // byte-identical — the probe is purely a speed choice. Gated on
+  // fast_ok: with a delimiter that can appear inside a decimal, the
+  // fused path must never pick cell boundaries.
+  const char dlm = cfg.delimiter;
+  bool fixed6 = false;
+  if (DelimiterFastOk(dlm)) {
+    const char* scan_end = b + std::min((size_t)512, (size_t)(e - b));
+    const char* c1 = b;
+    while (c1 < scan_end && *c1 != dlm && !is_nl(*c1)) ++c1;
+    if (c1 < scan_end && *c1 == dlm) {
+      const char* vb = c1 + 1;
+      fixed6 = LooksFixed6Cell(load8(vb, e), vb, e, dlm);
+    }
+  }
+  if (fixed6)
+    ParseCSVSliceImpl<true>(b, e, cfg, ncol_atom, a);
+  else
+    ParseCSVSliceImpl<false>(b, e, cfg, ncol_atom, a);
 }
 
 void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {

@@ -67,6 +67,20 @@ std::string make_libsvm_fixed6(int rows) {
   return out;
 }
 
+std::string make_csv_fixed6(int rows, int cols) {
+  std::string out;
+  char buf[32];
+  for (int i = 0; i < rows; ++i) {
+    for (int c = 0; c < cols; ++c) {
+      snprintf(buf, sizeof buf, "%s%d.%06d", c ? "," : "",
+               (int)(g_rng() % 10), (int)(g_rng() % 1000000));
+      out += buf;
+    }
+    out += "\n";
+  }
+  return out;
+}
+
 std::string make_libfm(int rows) {
   std::string out;
   char buf[64];
@@ -272,12 +286,14 @@ int main(int argc, char** argv) {
   // fixed-6-decimal corpora drive the SWAR paths and their fallthrough
   int t6 = fuzz_text(Format::kLibSVM, make_libsvm_short(60), iters);
   int t7 = fuzz_text(Format::kLibSVM, make_libsvm_fixed6(60), iters);
+  int t8 = fuzz_text(Format::kCSV, make_csv_fixed6(40, 8), iters);
   // sanity: the corrupting fuzz must actually hit rejection paths
   std::printf("fuzz complete: rejects libsvm=%d csv=%d libfm=%d "
-              "recordio=%d recidx=%d short=%d fixed6=%d of %d each\n",
-              t1, t2, t3, t4, t5, t6, t7, iters);
+              "recordio=%d recidx=%d short=%d fixed6=%d csv6=%d "
+              "of %d each\n",
+              t1, t2, t3, t4, t5, t6, t7, t8, iters);
   if (t1 == 0 || t2 == 0 || t3 == 0 || t4 == 0 || t5 <= 0 || t6 == 0 ||
-      t7 == 0) {
+      t7 == 0 || t8 == 0) {
     std::fprintf(stderr, "fuzz too weak: no rejections seen\n");
     return 1;
   }

@@ -132,6 +132,27 @@ class TestEngineParity:
         n = parse_all(str(p), "native", fmt="csv", label_column=0)
         assert g.content_hash() == n.content_hash()
 
+    def test_csv_fixed6_cell_shape_parity(self, tmp_path, rng):
+        # r4: the fused "d.dddddd" CELL path (csv flavor) — parity over
+        # edge shapes and rows mixing matching and non-matching cells
+        # (the per-cell fallback inside the fixed6 variant), including
+        # whitespace-padded cells and row-final cells before newline
+        edge = ["0.000000", "9.999999", "1.000000", "0.000001",
+                "0.123456"]
+        other = ["10.123456", "0.12345", "0.1234567", "2", "3e-1",
+                 "-0.500000", " 0.123456", "0.123456 "]
+        lines = ["1,0.654321,0.111111,0.222222"]  # probe: fixed6 selected
+        for i in range(400):
+            cells = [edge[rng.randint(len(edge))] for _ in range(3)]
+            if i % 3 == 0:
+                cells[rng.randint(3)] = other[rng.randint(len(other))]
+            lines.append(f"{i % 2}," + ",".join(cells))
+        p = tmp_path / "f6.csv"
+        p.write_bytes(("\n".join(lines) + "\n").encode())
+        g = parse_all(str(p), "python", fmt="csv", label_column=0)
+        n = parse_all(str(p), "native", fmt="csv", label_column=0)
+        assert g.content_hash() == n.content_hash()
+
     def test_csv_weight_column(self, tmp_path):
         p = tmp_path / "w.csv"
         p.write_bytes(b"1,0.5,9\n0,2.0,8\n")
