@@ -511,7 +511,7 @@ struct CSRArena {
   std::vector<uint64_t> index64;
   bool wide = false;
   Buf<float> value;
-  std::vector<int64_t> field;
+  Buf<int64_t> field;
   bool has_weight = false, has_qid = false, has_field = false;
   uint64_t min_index = UINT64_MAX;
   uint64_t max_index = 0;
@@ -1118,7 +1118,8 @@ struct ParserConfig {
 // silent heap overflow into a loud engine error at the first bad slice.
 inline void AuditCursorBounds(const CSRArena& a) {
   if (a.index32.n > a.index32.cap || a.value.n > a.value.cap ||
-      a.label.n > a.label.cap || a.offset.n > a.offset.cap)
+      a.label.n > a.label.cap || a.offset.n > a.offset.cap ||
+      a.field.n > a.field.cap)
     throw EngineError{
         "internal: parse cursors overran their reserved capacity "
         "(token-size invariant violated; please report)"};
@@ -1136,11 +1137,13 @@ inline void AuditCursorBounds(const CSRArena& a) {
 // branch-free.)
 inline void CheckRowCursors(const CSRArena& a, const uint32_t* ic,
                             const float* vc, const float* lc,
-                            const int64_t* oc) {
+                            const int64_t* oc,
+                            const int64_t* fc = nullptr) {
   if (lc >= a.label.data() + a.label.cap ||
       oc >= a.offset.data() + a.offset.cap ||
       ic > a.index32.data() + a.index32.cap ||
-      vc > a.value.data() + a.value.cap)
+      vc > a.value.data() + a.value.cap ||
+      (fc && fc > a.field.data() + a.field.cap))
     throw EngineError{
         "internal: parse cursors overran their reserved capacity "
         "(token-size invariant violated; please report)"};
@@ -1640,54 +1643,158 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
 }
 
 void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
-  // single pass, no line-end pre-scan (same structure as libsvm above)
+  size_t bytes = (size_t)(e - b);
+  // worst-case bounds reserved once → raw unchecked cursor writes on
+  // the hot path (same pattern as libsvm/csv; r4 brought libfm up to
+  // the same design): a feature token is >=6 bytes incl. separator
+  // ("f:i:v "), a row >=2 bytes incl. newline
+  a->field.reserve(a->field.size() + bytes / 6 + 1);
+  a->index32.reserve(a->index32.size() + bytes / 6 + 1);
+  a->value.reserve(a->value.size() + bytes / 6 + 1);
+  a->label.reserve(a->label.size() + bytes / 2 + 2);
+  a->offset.reserve(a->offset.size() + bytes / 2 + 2);
+  int64_t* fc = a->field.data() + a->field.size();
+  uint32_t* ic = a->index32.data() + a->index32.size();
+  float* vc = a->value.data() + a->value.size();
+  float* lc = a->label.data() + a->label.size();
+  int64_t* oc = a->offset.data() + a->offset.size();
+  int64_t off = oc[-1];  // arena invariant: offset always starts {0}
   const char* p = b;
   while (p < e) {
     while (p < e && (is_nl(*p) || is_ws(*p))) ++p;
     if (p >= e) break;
     float label;
-    double dlabel;
     const char* q;
-    const char* pend = parse_f64_prefix(p, e, &dlabel);
-    if (pend && (pend == e || is_ws(*pend) || is_nl(*pend))) {
-      label = (float)dlabel;
-      q = pend;
+    // single-digit and sign+digit labels — the dominant case (same
+    // fast path as libsvm; (float)digit equals the strtod result)
+    unsigned ld0 = (unsigned)(p[0] - '0');
+    if (ld0 <= 9 && (p + 1 == e || is_ws(p[1]) || is_nl(p[1]))) {
+      label = (float)ld0;
+      q = p + 1;
+    } else if ((p[0] == '-' || p[0] == '+') && p + 1 < e &&
+               (unsigned)(p[1] - '0') <= 9 &&
+               (p + 2 == e || is_ws(p[2]) || is_nl(p[2]))) {
+      label = (float)(int)(p[1] - '0');
+      if (p[0] == '-') label = -label;
+      q = p + 2;
     } else {
-      const char* lab_end = p;
-      while (lab_end < e && !is_ws(*lab_end) && !is_nl(*lab_end)) ++lab_end;
-      if (!parse_f32(p, lab_end, &label))
-        throw EngineError{"libfm: bad label '" + std::string(p, lab_end) +
-                          "'"};
-      q = lab_end;
+      double dlabel;
+      const char* pend = parse_f64_prefix(p, e, &dlabel);
+      if (pend && (pend == e || is_ws(*pend) || is_nl(*pend))) {
+        label = (float)dlabel;
+        q = pend;
+      } else {
+        const char* lab_end = p;
+        while (lab_end < e && !is_ws(*lab_end) && !is_nl(*lab_end))
+          ++lab_end;
+        if (!parse_f32(p, lab_end, &label))
+          throw EngineError{"libfm: bad label '" +
+                            std::string(p, lab_end) + "'"};
+        q = lab_end;
+      }
     }
     size_t row_nnz = 0;
     while (true) {
       while (q < e && is_ws(*q)) ++q;
       if (q >= e || is_nl(*q)) break;  // end of row
-      const char* tok_end = q;
-      while (tok_end < e && !is_ws(*tok_end) && !is_nl(*tok_end)) ++tok_end;
-      const char* c1 = nullptr;
-      const char* c2 = nullptr;
-      for (const char* c = q; c < tok_end; ++c)
-        if (*c == ':') { if (!c1) c1 = c; else { c2 = c; break; } }
       int64_t fld;
       uint64_t idx;
       float val;
-      if (!c1 || !c2 || !parse_i64(q, c1, &fld) ||
-          !parse_u64(c1 + 1, c2, &idx) || !parse_f32(c2 + 1, tok_end, &val))
-        throw EngineError{"libfm: bad token '" + std::string(q, tok_end) +
-                          "' (want field:idx:val)"};
-      a->field.push_back(fld);
-      a->push_index(idx);
-      a->value.push_back(val);
+      bool tok_done = false;
+      // fused path for the common "digits:digits:value" shape: field
+      // and index via one SWAR digit-run each (field/index <8 digits
+      // each covers every realistic libfm file), value via the same
+      // single-digit / fixed-6-decimal / general chain libsvm uses.
+      // Signed fields, huge indices, and malformed tokens fall to the
+      // general path below, which keeps the frozen error semantics.
+      {
+        uint64_t w = load8(q, e);
+        int kf = digit_run_len(w);
+        if (kf >= 1 && kf < 8 && q + kf < e && q[kf] == ':') {
+          const char* si = q + kf + 1;
+          uint64_t w2 = load8(si, e);
+          int ki = digit_run_len(w2);
+          if (ki >= 1 && ki < 8 && si + ki < e && si[ki] == ':') {
+            const char* sv = si + ki + 1;
+            unsigned vd0 = sv < e ? (unsigned)(sv[0] - '0') : 10u;
+            const char* vend = nullptr;
+            if (vd0 <= 9 &&
+                (sv + 1 == e || is_ws(sv[1]) || is_nl(sv[1]))) {
+              val = (float)vd0;
+              vend = sv + 1;
+            } else {
+              uint64_t vw = load8(sv, e);
+              if (LooksFixed6(vw, sv, e)) {
+                uint64_t x =
+                    (uint64_t)(((unsigned)vw & 0xff) - '0') * 1000000u +
+                    parse_digits_k(vw >> 16, 6);
+                val = (float)((double)x / 1e6);
+                vend = sv + 8;
+              } else {
+                double dv;
+                const char* pe2 = parse_f64_prefix(sv, e, &dv);
+                if (pe2 && (pe2 == e || is_ws(*pe2) || is_nl(*pe2))) {
+                  val = (float)dv;
+                  vend = pe2;
+                }
+              }
+            }
+            if (vend) {
+              fld = (int64_t)parse_digits_k_bl(w, kf);
+              idx = parse_digits_k_bl(w2, ki);
+              tok_done = true;
+              q = vend;
+            }
+          }
+        }
+      }
+      if (!tok_done) {  // general path: frozen two-colon semantics
+        const char* tok_end = q;
+        while (tok_end < e && !is_ws(*tok_end) && !is_nl(*tok_end))
+          ++tok_end;
+        const char* c1 = nullptr;
+        const char* c2 = nullptr;
+        for (const char* c = q; c < tok_end; ++c)
+          if (*c == ':') {
+            if (!c1) c1 = c;
+            else { c2 = c; break; }
+          }
+        if (!c1 || !c2 || !parse_i64(q, c1, &fld) ||
+            !parse_u64(c1 + 1, c2, &idx) ||
+            !parse_f32(c2 + 1, tok_end, &val))
+          throw EngineError{"libfm: bad token '" +
+                            std::string(q, tok_end) +
+                            "' (want field:idx:val)"};
+        q = tok_end;
+      }
+      DTP_DCHECK(fc < a->field.data() + a->field.cap);
+      *fc++ = fld;
+      if (!a->wide && idx <= UINT32_MAX) {
+        DTP_DCHECK(ic < a->index32.data() + a->index32.cap);
+        *ic++ = (uint32_t)idx;
+      } else {
+        // rare >u32 index: sync cursor, widen, continue via checked path
+        a->index32.n = (size_t)(ic - a->index32.data());
+        a->push_index(idx);
+        ic = a->index32.data() + a->index32.size();
+      }
+      DTP_DCHECK(vc < a->value.data() + a->value.cap);
+      *vc++ = val;
       ++row_nnz;
-      q = tok_end;
     }
     p = q;
     a->has_field = true;
-    a->label.push_back(label);
-    a->offset.push_back(a->offset.back() + (int64_t)row_nnz);
+    CheckRowCursors(*a, ic, vc, lc, oc, fc);
+    *lc++ = label;
+    off += (int64_t)row_nnz;
+    *oc++ = off;
   }
+  a->label.n = (size_t)(lc - a->label.data());
+  a->offset.n = (size_t)(oc - a->offset.data());
+  a->field.n = (size_t)(fc - a->field.data());
+  if (!a->wide) a->index32.n = (size_t)(ic - a->index32.data());
+  a->value.n = (size_t)(vc - a->value.data());
+  AuditCursorBounds(*a);
 }
 
 // Parse one whole chunk into one arena on the calling worker thread.

@@ -83,6 +83,28 @@ static std::string make_criteo(size_t target) {
   return s;
 }
 
+static std::string make_libfm(size_t target) {
+  std::mt19937 rng(3);
+  std::string s;
+  s.reserve(target + 256);
+  std::uniform_int_distribution<int> nnz(8, 18), fld(0, 30),
+      idx(0, 99999);
+  std::uniform_real_distribution<double> val(0.0, 1.0);
+  char buf[64];
+  int i = 0;
+  while (s.size() < target) {
+    s += (i++ % 2) ? "1" : "0";
+    int n = nnz(rng);
+    for (int k = 0; k < n; ++k) {
+      std::snprintf(buf, sizeof buf, " %d:%d:%.6f", fld(rng), idx(rng),
+                    val(rng));
+      s += buf;
+    }
+    s += '\n';
+  }
+  return s;
+}
+
 static std::string make_csv(size_t target) {
   std::mt19937 rng(2);
   std::uniform_real_distribution<double> val(0.0, 1.0);
@@ -134,6 +156,9 @@ static uint64_t digest(const CSRArena& a) {
     }
   if (a.has_qid)
     for (int64_t q : a.qid) mix((uint64_t)q);
+  if (a.has_field)
+    for (size_t i = 0; i < a.field.size(); ++i)
+      mix((uint64_t)a.field.data()[i]);
   mix(a.min_index);
   mix(a.max_index + 7);
   mix(a.has_weight ? 2 : 3);
@@ -194,6 +219,7 @@ int main(int argc, char** argv) {
   std::string a1a = make_a1a(mb << 20);
   std::string criteo = make_criteo(mb << 20);
   std::string csv = make_csv(mb << 20);
+  std::string fm = make_libfm(mb << 20);
 
   run("libsvm/a1a", a1a, iters,
       [](const char* b, const char* e, CSRArena* a) {
@@ -206,6 +232,10 @@ int main(int argc, char** argv) {
   ParserConfig cfg;
   cfg.format = Format::kCSV;
   cfg.label_column = 0;
+  run("libfm", fm, iters,
+      [](const char* b, const char* e, CSRArena* a) {
+        ParseLibFMSlice(b, e, a);
+      });
   run("csv/higgs", csv, iters,
       [&cfg](const char* b, const char* e, CSRArena* a) {
         std::atomic<long> ncol(-1);

@@ -132,6 +132,41 @@ class TestEngineParity:
         n = parse_all(str(p), "native", fmt="csv", label_column=0)
         assert g.content_hash() == n.content_hash()
 
+    def test_libfm_fused_shape_parity(self, tmp_path, rng):
+        # r4: the libfm raw-cursor rewrite — parity over the fused
+        # branches AND their fallthroughs: sign labels, single-digit /
+        # fixed6 / general values, 8+-digit fields and indices (general
+        # path), a mid-slice >u32 index (widen + cursor resync), and a
+        # missing trailing newline
+        tok = ["3:17:1", "0:0:0", "30:99999:0.123456", "7:123:0.5",
+               "12345678:5:1",          # 8-digit field -> general path
+               "2:123456789:2",         # 9-digit index -> general path
+               "1:5000000000:1",        # >u32 index -> widen + resync
+               "+4:8:1", "-2:9:0.25",   # signed fields -> general path
+               "5:6:1e-2", "8:9:-3.5"]
+        lines = []
+        for i in range(500):
+            n = rng.randint(1, 7)
+            toks = [tok[rng.randint(len(tok))] for _ in range(n)]
+            lab = ["1", "-1", "+1", "0", "0.5"][rng.randint(5)]
+            lines.append(f"{lab} " + " ".join(toks))
+        body = "\n".join(lines) + "\n1 3:4:7"  # no trailing newline
+        p = tmp_path / "fm.libfm"
+        p.write_bytes(body.encode())
+        g = parse_all(str(p), "python", fmt="libfm")
+        n = parse_all(str(p), "native", fmt="libfm")
+        assert g.content_hash() == n.content_hash()
+        assert n.field is not None
+        # and with a u64 container the widened index survives intact
+        gc = RowBlockContainer(np.uint64)
+        pg = Parser.create(str(p), 0, 1, format="libfm", engine="native",
+                           index_dtype=np.uint64)
+        for blk in pg:
+            gc.push_block(blk)
+        if hasattr(pg, "destroy"):
+            pg.destroy()
+        assert int(gc.get_block().index.max()) == 5000000000
+
     def test_csv_fixed6_cell_shape_parity(self, tmp_path, rng):
         # r4: the fused "d.dddddd" CELL path (csv flavor) — parity over
         # edge shapes and rows mixing matching and non-matching cells
