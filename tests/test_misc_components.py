@@ -87,6 +87,25 @@ class TestBufferPool:
         assert thread_local_pool() is thread_local_pool()
 
 
+class TestBenchTransferProbe:
+    """CI smoke for the transfer-ceiling probe (BASELINE.md "Transfer
+    ceiling" cites it as rerunnable evidence): every cell runs tiny on
+    the CPU backend and returns a positive rate."""
+
+    def test_cells_run_tiny(self):
+        import jax
+        from dmlc_tpu import bench_transfer as bt
+        dev = jax.devices()[0]
+        assert bt.memcpy_gauge(mb=2) > 0
+        assert bt.cell_single(dev, 1, 2, 4) > 0
+        assert bt.cell_threads(dev, 2, 1, 1, 4) > 0
+        assert bt.cell_mono(dev, 2) > 0
+        share = bt.enqueue_cpu_share(dev, chunk_mb=1, total_mb=2)
+        assert 0.0 <= share <= 2.0
+        rate, copied = bt.cell_under_cpu_load(dev, 1, 1, 2)
+        assert rate > 0 and copied >= 0
+
+
 class TestProfiler:
     def test_stage_accumulation(self):
         p = Profiler()
