@@ -634,8 +634,13 @@ class TestPipelineScaling:
         wall4, blocks4, _ = self._timed_epoch(path, 4, delay, rounds)
         assert blocks1 == blocks4
         scaling = wall1 / wall4
-        assert scaling >= 3.0, \
-            f"byte-touching pipeline scaling {scaling:.2f}x < 3.0x " \
+        # bar: 2.8x = ~87% of the 3.21x pessimistic bound above —
+        # measured 3.2-3.3x solo, but a loaded CI host (another test
+        # stealing the core mid-cell) can shave a few percent and this
+        # must not flake the suite; no-overlap serialization would
+        # measure ~1x, far below either number
+        assert scaling >= 2.8, \
+            f"byte-touching pipeline scaling {scaling:.2f}x < 2.8x " \
             f"({chunks} chunks, rounds={rounds}, wall1={wall1:.2f}s " \
             f"wall4={wall4:.2f}s)"
 
