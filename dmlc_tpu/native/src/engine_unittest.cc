@@ -374,7 +374,38 @@ static void test_recordio_shard_coverage() {
   }
 }
 
+static void test_block_cache() {
+  // semantics the fault-elimination story rides on (r4): best-fit
+  // >=-matching over 2 MB-granular classes, accurate budget
+  // accounting, smallest-first eviction at the cap
+  auto& c = BlockCache::I();
+  // drain whatever earlier tests parked so accounting starts known
+  while (true) {
+    auto pr = c.Get(1);
+    if (!pr.first) break;
+    ::operator delete(pr.first);
+  }
+  const size_t m2 = (size_t)2 << 20, m4 = (size_t)4 << 20;
+  void* a = ::operator new(m2);
+  void* b = ::operator new(m4);
+  CHECK_TRUE(c.Put(a, m2));
+  CHECK_TRUE(c.Put(b, m4));
+  // best-fit: a 3 MB request must be served by the 4 MB block, not 2 MB
+  auto got = c.Get(3 << 20);
+  CHECK_TRUE(got.first == b);
+  CHECK_EQ_(got.second, m4);
+  // the 2 MB block still serves an exact-class request
+  auto got2 = c.Get(m2);
+  CHECK_TRUE(got2.first == a);
+  // cache now empty: a miss returns {nullptr, 0}
+  auto miss = c.Get(1);
+  CHECK_TRUE(miss.first == nullptr && miss.second == 0);
+  ::operator delete(a);
+  ::operator delete(b);
+}
+
 int main() {
+  test_block_cache();
   test_digit_run_len();
   test_parse_digits_k();
   test_load8_clamp();
