@@ -49,8 +49,16 @@ def main() -> int:
     replicated = NamedSharding(mesh, P())
     params = jax.device_put(model.init_params(), replicated)
     step_fn = model.make_sharded_train_step(mesh)
+    # DMLC_TEST_CACHE_BYTES_RANK0: force THIS rank over/under the
+    # epoch-1 cache budget to exercise the mixed-vote path — one rank
+    # over budget must vote EVERY rank onto the legacy per-round
+    # protocol (protocols may never mix across ranks)
+    cache_bytes = 1 << 30
+    if pid == 0 and os.environ.get("DMLC_TEST_CACHE_BYTES_RANK0"):
+        cache_bytes = int(os.environ["DMLC_TEST_CACHE_BYTES_RANK0"])
     it = ShardedRowBlockIter(data_uri, mesh, format="libsvm",
-                             row_bucket=ROW_BUCKET, nnz_bucket=NNZ_BUCKET)
+                             row_bucket=ROW_BUCKET, nnz_bucket=NNZ_BUCKET,
+                             agreement_cache_bytes=cache_bytes)
     ck = ShardedCheckpoint(os.path.join(out_dir, "ckpt"))
 
     def digest(p):

@@ -68,6 +68,30 @@ def _read_results(out_dir: str, phase: str, world: int):
 
 @pytest.mark.slow
 class TestMultiProcessDistributed:
+    def test_mixed_cache_vote_falls_back_consistently(self, skewed_file,
+                                                      tmp_path):
+        """One rank over the epoch-1 cache budget must vote BOTH ranks
+        onto the legacy per-round protocol (mixing protocols across
+        ranks would mismatch collectives and hang): the gang still
+        agrees on batch counts, and epoch 1 shows the per-round
+        collective cadence instead of the single allgather."""
+        mp_dir = str(tmp_path / "mixed")
+        os.makedirs(mp_dir)
+        env = _worker_env(2)
+        env["DMLC_TEST_CACHE_BYTES_RANK0"] = "0"  # rank 0 over budget
+        launch_local(2, [sys.executable, WORKER, skewed_file, mp_dir,
+                         "train"],
+                     env=env, timeout=600)
+        results = _read_results(mp_dir, "train", 2)
+        assert results[0]["nbatches"] == results[1]["nbatches"] > 0
+        assert results[0]["params_digest"] == results[1]["params_digest"]
+        for r in results:
+            # legacy protocol: one done-flag allgather per round (the
+            # vote itself is the +1); steady state still collective-free
+            assert r["epoch_collectives"][0] >= r["epoch_batches"][0], \
+                f"expected per-round cadence: {r['epoch_collectives']}"
+            assert r["epoch_collectives"][1] == 0
+
     def test_two_process_train_matches_single_process(self, skewed_file,
                                                       tmp_path):
         mp_dir = str(tmp_path / "mp")
