@@ -19,7 +19,6 @@ import numpy as np
 
 from dmlc_tpu.data.parser import DataIter, PARSER_REGISTRY, Parser
 from dmlc_tpu.data.rowblock import RowBlock
-from dmlc_tpu.io.uri_spec import URISpec
 from dmlc_tpu.utils.logging import DMLCError, check
 from dmlc_tpu.utils.parameter import Parameter, field
 
@@ -52,8 +51,11 @@ class ParquetParser(Parser):
         self.param = ParquetParserParam()
         self.param.update_allow_unknown(kwargs)
         self.index_dtype = np.dtype(index_dtype)
-        spec = URISpec(uri)
-        paths = spec.paths()
+        # same URI expansion as InputSplit (';'-joined and/or
+        # directories of part files — the Hadoop-style dataset layout;
+        # reference: InputSplitBase::Init's ListDirectory expansion)
+        from dmlc_tpu.io.input_split import list_split_files
+        paths = [p for p, _size in list_split_files(uri)]
         check(len(paths) >= 1, "parquet: no input path")
         self._files = [_pq.ParquetFile(p) for p in paths]
         # (file_idx, row_group_idx) pairs round-robined across parts

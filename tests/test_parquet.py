@@ -60,6 +60,28 @@ class TestParquetParser:
         # row groups are whole units: sorting restores equality
         np.testing.assert_array_equal(np.sort(got), np.sort(whole.label))
 
+    def test_directory_of_part_files(self, tmp_path, rng):
+        # r4: a directory URI expands to its part files (the
+        # Hadoop-style dataset layout), same rule as InputSplit
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        d = tmp_path / "dataset"
+        d.mkdir()
+        tables = []
+        for k in range(3):
+            t = pa.table({"label": pa.array(
+                rng.rand(20).astype(np.float32)),
+                "f0": pa.array(rng.rand(20).astype(np.float32))})
+            pq.write_table(t, str(d / f"part-{k}.parquet"))
+            tables.append(t)
+        block = drain(Parser.create(str(d), format="parquet",
+                                    label_column="label"))
+        assert block.size == 60
+        got = np.sort(block.label)
+        want = np.sort(np.concatenate(
+            [t.column("label").to_numpy() for t in tables]))
+        np.testing.assert_array_equal(got, want)
+
     def test_no_label_column(self, parquet_file):
         path, _ = parquet_file
         block = drain(Parser.create(path, 0, 1, format="parquet"))
