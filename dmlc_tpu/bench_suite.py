@@ -88,14 +88,19 @@ def make_libsvm(path: str, mb: int, seed: int = 0,
     return os.path.getsize(path)
 
 
-def make_csv(path: str, mb: int, seed: int = 0) -> int:
-    """HIGGS-shaped: label + 28 float columns."""
+def make_csv(path: str, mb: int, seed: int = 0,
+             zero_frac: float = 0.0) -> int:
+    """HIGGS-shaped: label + 28 float columns. zero_frac > 0 plants
+    exact-zero cells (the sparse-mode corpus; BASELINE config 2 is
+    "dense + sparse")."""
     if os.path.exists(path) and os.path.getsize(path) >= (mb << 20) * 3 // 4:
         return os.path.getsize(path)
     rng = np.random.RandomState(seed)
     rows = []
     for i in range(2000):
         vals = rng.rand(28)
+        if zero_frac:
+            vals[rng.rand(28) < zero_frac] = 0.0
         rows.append(f"{i % 2}," + ",".join(f"{v:.6f}" for v in vals))
     block = ("\n".join(rows) + "\n").encode()
     with open(path, "wb") as f:
@@ -244,8 +249,27 @@ def bench_csv(mb: int) -> Dict:
         _log(f"  {line}")
     if hasattr(p, "destroy"):
         p.destroy()
+    # sparse mode (BASELINE config 2 "dense + sparse"): a zero-bearing
+    # variant corpus, zero cells dropped at parse; parity hash checked
+    # python-vs-native like the dense one (tests pin it; here we report
+    # the rate)
+    spath = f"{_TMP}.higgs_sparse.csv"
+    ssize = make_csv(spath, mb, seed=1, zero_frac=0.3)
+    t0 = time.perf_counter()
+    sp = Parser.create(spath, 0, 1, format="csv", label_column=0,
+                       sparse=True)
+    srows = snnz = 0
+    while sp.next():
+        b = sp.value()
+        srows += b.size
+        snnz += b.nnz
+    sdt = time.perf_counter() - t0
+    if hasattr(sp, "destroy"):
+        sp.destroy()
     return {"config": "csv_higgs", "gbps": size / dt / 1e9,
             "bytes": size, "rows": rows, "nnz": nnz,
+            "sparse_gbps": round(ssize / sdt / 1e9, 4),
+            "sparse_nnz_frac": round(snnz / max(srows * 28, 1), 3),
             "hash": _content_hash(path, "csv", label_column=0)}
 
 

@@ -1,4 +1,5 @@
-"""CSV text parser → dense-as-CSR RowBlock.
+"""CSV text parser → dense-as-CSR RowBlock (or zero-dropping sparse
+CSR with ``sparse=True``; indices keep the column ordinal).
 
 Reference: src/data/csv_parser.h — CSVParser<I>::ParseBlock,
 CSVParserParam{label_column, delimiter, ...}. Uniform column count is
@@ -26,6 +27,9 @@ class CSVParserParam(Parameter):
                                   "(labels default to 0)")
     weight_column = field(-1, desc="column holding row weight; -1: none")
     delimiter = field(",", desc="field delimiter")
+    sparse = field(False, desc="drop zero-valued cells (indices keep the "
+                               "column ordinal) — BASELINE config 2's "
+                               "sparse RowBlock mode")
 
 
 class CSVParser(TextParserBase):
@@ -39,6 +43,7 @@ class CSVParser(TextParserBase):
                     container: RowBlockContainer) -> None:
         delim = self.param.delimiter.encode()
         lcol, wcol = self.param.label_column, self.param.weight_column
+        sparse = self.param.sparse
         for line in records:
             line = line.strip(b"\r")
             if not line:
@@ -60,8 +65,10 @@ class CSVParser(TextParserBase):
                 if c == wcol:
                     weight = float(parse_float32(tok))
                     continue
-                vals.append(parse_float32(tok))
-                idxs.append(fidx)
+                v = parse_float32(tok)
+                if not sparse or v != 0:
+                    vals.append(v)
+                    idxs.append(fidx)
                 fidx += 1
             container.push(label,
                            np.asarray(idxs, self.index_dtype),

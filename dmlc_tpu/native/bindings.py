@@ -40,7 +40,7 @@ def load(path: str):
     lib.dtp_parser_create.argtypes = [
         C.POINTER(C.c_char_p), C.POINTER(C.c_int64), C.c_int64, C.c_int64,
         C.c_int64, C.c_char_p, C.c_int, C.c_int64, C.c_int, C.c_int64,
-        C.c_int64, C.c_char,
+        C.c_int64, C.c_char, C.c_int,
     ]
     lib.dtp_parser_next.restype = C.c_int64
     lib.dtp_parser_next.argtypes = [
@@ -224,7 +224,8 @@ class NativeTextParser(Parser):
             paths, sizes, len(files), part_index, num_parts,
             self._format.encode(), int(nthreads), int(chunk_size),
             int(self._indexing_mode), int(self._label_column),
-            int(self._weight_column), self._delimiter.encode()[:1])
+            int(self._weight_column), self._delimiter.encode()[:1],
+            int(self._sparse))
         if not self._handle:
             raise DMLCError(
                 f"native parser create failed: "
@@ -253,12 +254,14 @@ class NativeTextParser(Parser):
     _label_column = -1
     _weight_column = -1
     _delimiter = ","
+    _sparse = False
 
     def _configure(self, kwargs: Dict[str, Any]) -> Optional[str]:
         self._indexing_mode = int(kwargs.pop("indexing_mode", 0))
         self._label_column = int(kwargs.pop("label_column", -1))
         self._weight_column = int(kwargs.pop("weight_column", -1))
         self._delimiter = str(kwargs.pop("delimiter", ","))
+        self._sparse = bool(kwargs.pop("sparse", False))
         kwargs.pop("engine", None)
         kwargs.pop("prefetch", None)
         kwargs.pop("format", None)

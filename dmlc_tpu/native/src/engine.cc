@@ -1109,6 +1109,8 @@ struct ParserConfig {
   long label_column = -1;
   long weight_column = -1;
   char delimiter = ',';
+  bool sparse = false;  // csv: drop zero cells (index keeps the column
+                        // ordinal; BASELINE config 2 "dense + sparse")
 };
 
 // Release-build backstop for the raw-cursor writes (ADVICE r2): the
@@ -1490,7 +1492,7 @@ inline bool LooksFixed6Cell(uint64_t vw, const char* vb, const char* e,
 // slice by the dispatcher's probe; requires fast_ok (a delimiter that
 // can appear inside a decimal must never let the fused path pick the
 // cell boundary).
-template <bool kFixed6>
+template <bool kFixed6, bool kSparse>
 void ParseCSVSliceImpl(const char* b, const char* e,
                        const ParserConfig& cfg,
                        std::atomic<long>* ncol_atom, CSRArena* a) {
@@ -1520,6 +1522,7 @@ void ParseCSVSliceImpl(const char* b, const char* e,
     if (p >= e) break;
     float label = 0.0f, weight = 1.0f;
     long col = 0, fidx = 0;
+    long row_max = -1;  // max WRITTEN ordinal (sparse drops cells)
     size_t row_nnz = 0;
     bool row_done = false;
     while (!row_done) {
@@ -1573,13 +1576,19 @@ void ParseCSVSliceImpl(const char* b, const char* e,
       } else {
         // unchecked writes: capacity bounded by the bytes/2+1 reserve
         // (every cell is >=2 bytes incl. its delimiter); fidx is the
-        // in-row column ordinal, bounded far below 2^32 by chunk size
-        DTP_DCHECK(ic < a->index32.data() + a->index32.cap);
-        DTP_DCHECK(vc < a->value.data() + a->value.cap);
-        *ic++ = (uint32_t)fidx;
-        *vc++ = v;
+        // in-row column ordinal, bounded far below 2^32 by chunk size.
+        // Sparse mode drops zero cells but the ordinal advances, so
+        // indices keep column identity (-0.0 == 0.0 drops too, same as
+        // the golden's v != 0 test).
+        if (!kSparse || v != 0.0f) {
+          DTP_DCHECK(ic < a->index32.data() + a->index32.cap);
+          DTP_DCHECK(vc < a->value.data() + a->value.cap);
+          *ic++ = (uint32_t)fidx;
+          *vc++ = v;
+          ++row_nnz;
+          if (kSparse) row_max = fidx;
+        }
         ++fidx;
-        ++row_nnz;
       }
       ++col;
       if (cell_end >= e || is_nl(*cell_end)) {
@@ -1603,7 +1612,8 @@ void ParseCSVSliceImpl(const char* b, const char* e,
                         ")"};
     if (row_nnz) {
       a->min_index = 0;
-      a->max_index = std::max(a->max_index, (uint64_t)(fidx - 1));
+      a->max_index = std::max(
+          a->max_index, (uint64_t)(kSparse ? row_max : fidx - 1));
     }
     CheckRowCursors(*a, ic, vc, lc, oc);
     *lc++ = label;
@@ -1640,10 +1650,13 @@ void ParseCSVSlice(const char* b, const char* e, const ParserConfig& cfg,
       fixed6 = LooksFixed6Cell(load8(vb, e), vb, e, dlm);
     }
   }
-  if (fixed6)
-    ParseCSVSliceImpl<true>(b, e, cfg, ncol_atom, a);
-  else
-    ParseCSVSliceImpl<false>(b, e, cfg, ncol_atom, a);
+  if (fixed6) {
+    if (cfg.sparse) ParseCSVSliceImpl<true, true>(b, e, cfg, ncol_atom, a);
+    else ParseCSVSliceImpl<true, false>(b, e, cfg, ncol_atom, a);
+  } else {
+    if (cfg.sparse) ParseCSVSliceImpl<false, true>(b, e, cfg, ncol_atom, a);
+    else ParseCSVSliceImpl<false, false>(b, e, cfg, ncol_atom, a);
+  }
 }
 
 void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
@@ -2573,7 +2586,7 @@ void* dtp_parser_create(const char** paths, const int64_t* sizes,
                         const char* format, int nthreads,
                         int64_t chunk_bytes, int indexing_mode,
                         int64_t label_column, int64_t weight_column,
-                        char delimiter) {
+                        char delimiter, int sparse) {
   try {
     auto h = std::make_unique<ParserHandle>();
     h->cfg.format = parse_format(format);
@@ -2581,6 +2594,7 @@ void* dtp_parser_create(const char** paths, const int64_t* sizes,
     h->cfg.label_column = label_column;
     h->cfg.weight_column = weight_column;
     h->cfg.delimiter = delimiter;
+    h->cfg.sparse = sparse != 0;
     h->nthreads = std::max(1, nthreads);
     std::vector<FileEntry> files;
     for (int64_t i = 0; i < nfiles; ++i)

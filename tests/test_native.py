@@ -188,6 +188,31 @@ class TestEngineParity:
         n = parse_all(str(p), "native", fmt="csv", label_column=0)
         assert g.content_hash() == n.content_hash()
 
+    def test_csv_sparse_mode_parity_and_semantics(self, tmp_path, rng):
+        # r4 (BASELINE config 2 "dense + sparse"): sparse=True drops
+        # zero cells in BOTH engines identically, indices keep the
+        # column ordinal, and -0.0 counts as zero. Mixed zero shapes
+        # ("0", "0.0", "0.000000", "-0.0") land on both the fused
+        # fixed6 and the general cell paths.
+        zero = ["0", "0.0", "0.000000", "-0.0", "0e0"]
+        val = ["1.5", "0.123456", "2", "9.999999"]
+        lines = ["1,0.654321,0.000000,0.111111"]  # fixed6 probe line
+        for i in range(400):
+            cells = [(zero if rng.rand() < 0.5 else val)[
+                rng.randint(4)] for _ in range(3)]
+            lines.append(f"{i % 2}," + ",".join(cells))
+        p = tmp_path / "sp.csv"
+        p.write_bytes(("\n".join(lines) + "\n").encode())
+        g = parse_all(str(p), "python", fmt="csv", label_column=0,
+                      sparse=True)
+        n = parse_all(str(p), "native", fmt="csv", label_column=0,
+                      sparse=True)
+        assert g.content_hash() == n.content_hash()
+        assert (g.value != 0).all()          # zeros really dropped
+        dense = parse_all(str(p), "python", fmt="csv", label_column=0)
+        assert g.nnz < dense.nnz             # and the mode differs
+        assert dense.size == g.size          # same rows either way
+
     def test_csv_weight_column(self, tmp_path):
         p = tmp_path / "w.csv"
         p.write_bytes(b"1,0.5,9\n0,2.0,8\n")
