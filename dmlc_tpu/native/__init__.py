@@ -16,6 +16,7 @@ from typing import Optional
 
 _lib = None
 _tried = False
+_load_error: Optional[str] = None
 
 
 def _lib_path() -> str:
@@ -23,7 +24,7 @@ def _lib_path() -> str:
 
 
 def native_available() -> bool:
-    global _lib, _tried
+    global _lib, _tried, _load_error
     if not _tried:
         _tried = True
         path = _lib_path()
@@ -31,16 +32,25 @@ def native_available() -> bool:
             try:
                 from dmlc_tpu.native import bindings
                 _lib = bindings.load(path)
-            except Exception:
+            except Exception as e:  # noqa: BLE001
+                # a present-but-unloadable .so (stale ABI, bad build) must
+                # not silently degrade to the Python engines: say why once,
+                # and keep the reason for get_lib()'s error
                 _lib = None
+                _load_error = str(e)
+                from dmlc_tpu.utils.logging import log_warning
+                log_warning(f"native engine present but unusable "
+                            f"({_load_error}); using Python engines")
     return _lib is not None
 
 
 def get_lib():
     if not native_available():
         from dmlc_tpu.utils.logging import DMLCError
+        detail = (f" (load failed: {_load_error})" if _load_error
+                  else "")
         raise DMLCError("native engine not built; run "
-                        "`python -m dmlc_tpu.native.build`")
+                        f"`python -m dmlc_tpu.native.build`{detail}")
     return _lib
 
 

@@ -28,6 +28,10 @@ __all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
 
 _lib = None
 
+# Must equal dtp_version() in engine.cc. Bumped on every C ABI signature
+# change (3: dtp_parser_create grew the `sparse` argument).
+ABI_VERSION = 3
+
 
 def load(path: str):
     global _lib
@@ -36,6 +40,11 @@ def load(path: str):
     lib = C.CDLL(path)
     lib.dtp_last_error.restype = C.c_char_p
     lib.dtp_version.restype = C.c_int
+    got = lib.dtp_version()
+    if got != ABI_VERSION:
+        raise OSError(
+            f"libdmlc_tpu.so ABI {got} != expected {ABI_VERSION}; "
+            "rebuild with `python -m dmlc_tpu.native.build`")
     lib.dtp_parser_create.restype = C.c_void_p
     lib.dtp_parser_create.argtypes = [
         C.POINTER(C.c_char_p), C.POINTER(C.c_int64), C.c_int64, C.c_int64,

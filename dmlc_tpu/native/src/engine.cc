@@ -2577,7 +2577,10 @@ extern "C" {
 
 const char* dtp_last_error() { return g_last_error.c_str(); }
 
-int dtp_version() { return 2; }
+// ABI history: 1 = initial; 2 = lease-based dtp_parser_next outparams;
+// 3 = dtp_parser_create grew the 13th `sparse` argument (CSV zero-drop).
+// Bump on ANY signature change — bindings.load() refuses mismatches.
+int dtp_version() { return 3; }
 
 // files: paths array; sizes must match the Python VFS listing so the
 // shard contract is identical across engines.

@@ -512,7 +512,7 @@ class TestTruncatedFile:
         paths = (C.c_char_p * 1)(str(p).encode())
         sizes = (C.c_int64 * 1)(10_000)  # lie: promise more bytes
         h = lib.dtp_parser_create(paths, sizes, 1, 0, 1, b"libsvm", 1,
-                                  1 << 20, 0, -1, -1, b",")
+                                  1 << 20, 0, -1, -1, b",", 0)
         assert h
         from dmlc_tpu.native.bindings import NativeLibSVMParser
         parser = NativeLibSVMParser.__new__(NativeLibSVMParser)
