@@ -57,7 +57,10 @@ def main() -> int:
         nbatches = n
     with open(os.path.join(out_dir, f"bench-mp-{pid}.json"), "w") as f:
         json.dump({"rank": pid, "world": nprocs, "batches": nbatches,
-                   "epoch_walls": epoch_walls}, f)
+                   "epoch_walls": epoch_walls,
+                   # epochs 2-3 should serve from the retained rounds
+                   # (steady replay, VERDICT r4 #2)
+                   "replay_epochs": it.replay_epochs}, f)
     finalize()
     return 0
 

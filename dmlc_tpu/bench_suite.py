@@ -545,7 +545,10 @@ def bench_multiprocess_ingest(mb: int) -> Dict:
             "gbps": size / steady / 1e9, "bytes": size,
             "batches_per_epoch": results[0]["batches"],
             "first_epoch_gbps": round(size / first / 1e9, 4),
-            "steady_over_first": round(first / steady, 2)}
+            "steady_over_first": round(first / steady, 2),
+            # steady epochs serve retained rounds (no re-parse) when
+            # the shard fit the cache budget — the r5 replay path
+            "replay_epochs": results[0].get("replay_epochs", 0)}
 
 
 def bench_page_replay(mb: int, rows_per_page: int = 8 << 10) -> Dict:

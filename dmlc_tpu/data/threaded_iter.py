@@ -162,7 +162,14 @@ class ThreadedIter(Generic[T]):
             self._not_empty.notify_all()
         self._producer_wake.set()
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            try:
+                self._thread.join(timeout=5.0)
+            except TypeError:
+                # interpreter shutdown: threading internals are already
+                # torn down when an abandoned generator's finally runs
+                # destroy from a late GC — the daemon thread dies with
+                # the process either way
+                pass
             self._thread = None
 
     def __iter__(self):

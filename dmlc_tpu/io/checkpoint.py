@@ -122,12 +122,19 @@ class ShardedCheckpoint:
         """Committed directory for a step. Every save writes into
         ``step-N.new`` and swaps it in only once fully committed; if a
         crash interrupted the swap, the committed ``.new`` IS the step —
-        the previously committed data is never the casualty."""
+        the previously committed data is never the casualty.
+
+        When BOTH are committed (crash between .new's COMMIT and the
+        swap renames), the .new wins: save() strips COMMIT from .new
+        before reusing it, so a committed .new is always the newer save
+        of this step (ADVICE r4). Which copy a step resolves to is then
+        stable across time — the next save's swap promotes the same one
+        restore has been serving."""
         d = self._step_dir(step)
-        if self._committed(d):
-            return d
         if self._committed(d + ".new"):
             return d + ".new"
+        if self._committed(d):
+            return d
         return d  # caller's commit check reports the right error
 
     def _committed_steps(self) -> List[int]:

@@ -368,6 +368,9 @@ class BlockCache {
   // never as an exception escaping a destructor.
   bool Put(void* p, size_t bytes) {
     std::lock_guard<std::mutex> g(mu_);
+    // a block that cannot fit even in an empty cache must not evict the
+    // whole warm set on its way to an inevitable false (ADVICE r4)
+    if (bytes > cap_) return false;
     try {
       while (held_ + bytes > cap_ && !free_.empty()) {
         auto it = free_.begin();  // evict smallest class first

@@ -400,11 +400,26 @@ static void test_block_cache() {
   // cache now empty: a miss returns {nullptr, 0}
   auto miss = c.Get(1);
   CHECK_TRUE(miss.first == nullptr && miss.second == 0);
+  // an over-cap block is refused WITHOUT evicting the warm set
+  // (ADVICE r4): park both blocks again, offer one larger than the
+  // whole budget (virtual alloc only — pages never touched), and the
+  // warm blocks must still be servable afterwards
+  CHECK_TRUE(c.Put(a, m2));
+  CHECK_TRUE(c.Put(b, m4));
+  const size_t over = (size_t)600 << 20;  // > 512 MB default cap
+  void* big = ::operator new(over);
+  CHECK_TRUE(!c.Put(big, over));
+  ::operator delete(big);
+  CHECK_TRUE(c.Get(3 << 20).first == b);
+  CHECK_TRUE(c.Get(m2).first == a);
   ::operator delete(a);
   ::operator delete(b);
 }
 
 int main() {
+  // the cache-cap assertions below assume the default 512 MB budget;
+  // BlockCache::I() reads the env once at first use, which is here
+  setenv("DMLC_TPU_BLOCK_CACHE_MB", "512", 1);
   test_block_cache();
   test_digit_run_len();
   test_parse_digits_k();

@@ -325,6 +325,29 @@ class TestShardLocalRestore:
         np.testing.assert_array_equal(np.asarray(restored["x"]),
                                       np.asarray(tree["x"]))
 
+    def test_both_committed_serves_newer_new(self, tmp_path, monkeypatch):
+        # ADVICE r4: crash BETWEEN .new's COMMIT and the swap renames
+        # leaves BOTH step-1 and step-1.new committed. The .new is
+        # provably the newer save (save() strips COMMIT from .new before
+        # reuse) — restore must serve it, and keep serving the same data
+        # after a later save promotes it (no flip-flop over time).
+        tree, mesh, sh = self._tree()
+        newer = {"x": np.asarray(tree["x"]) + 100.0}
+        ck = ShardedCheckpoint(str(tmp_path / "r"))
+        ck.save(1, tree)
+        monkeypatch.setattr(ShardedCheckpoint, "_swap_in",
+                            staticmethod(lambda final: None))
+        ck.save(1, newer)  # commits .new, "crashes" before the swap
+        monkeypatch.undo()
+        restored, _ = ck.restore(like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(newer["x"]))
+        # later activity elsewhere must not flip which copy step 1 means
+        ck.save(2, tree)
+        restored, _ = ck.restore(step=1, like=tree)
+        np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                      np.asarray(newer["x"]))
+
     def test_save_over_interrupted_swap_crash_keeps_committed(
             self, tmp_path, monkeypatch):
         # r4 regression (code review): start from the mid-swap state
