@@ -55,9 +55,17 @@ class ParquetParser(Parser):
         # directories of part files — the Hadoop-style dataset layout;
         # reference: InputSplitBase::Init's ListDirectory expansion)
         from dmlc_tpu.io.input_split import list_split_files
-        paths = [p for p, _size in list_split_files(uri)]
-        check(len(paths) >= 1, "parquet: no input path")
-        self._files = [_pq.ParquetFile(p) for p in paths]
+        entries = list_split_files(uri)
+        check(len(entries) >= 1, "parquet: no input path")
+        # Parquet rides the SAME Stream/VFS seam as every text parser
+        # (reference parity: src/data/parser.h takes InputSplit, all IO
+        # via src/io/): a plain local path goes to pyarrow directly (its
+        # mmap fast path), anything else — any scheme registered via
+        # FileSystem.register_scheme with a seekable open() — is handed
+        # to pyarrow as a buffered file-like over the SeekStream
+        # (VERDICT r4 #7).
+        self._sources = [self._open_source(p, size) for p, size in entries]
+        self._files = [_pq.ParquetFile(s) for s in self._sources]
         # (file_idx, row_group_idx) pairs round-robined across parts
         groups = [(fi, gi) for fi, f in enumerate(self._files)
                   for gi in range(f.num_row_groups)]
@@ -72,6 +80,24 @@ class ParquetParser(Parser):
         # before_first() first, which would discard (and re-read) any
         # eagerly prefetched row groups
         self._want_prefetch = prefetch and len(self._groups) > 1
+
+    @staticmethod
+    def _open_source(path: str, size: int):
+        """Local path, or a buffered seekable file-like over the VFS
+        stream for registered schemes. Non-seekable streams fail with
+        the adapter's UnsupportedOperation naming the fix (pyarrow
+        needs random access to read the footer)."""
+        import io as _io
+        import os
+        from dmlc_tpu.io.stream import SeekStream, create_stream
+        from dmlc_tpu.io.tpu_fs import local_path
+        lp = local_path(path)
+        if os.path.isfile(lp):
+            return lp
+        stream = create_stream(path, "r")
+        raw = stream.as_file(size=size if isinstance(stream, SeekStream)
+                             else None)
+        return _io.BufferedReader(raw, buffer_size=1 << 20)
 
     # -- producer hooks (run on the prefetch thread)
 
@@ -114,6 +140,17 @@ class ParquetParser(Parser):
         if self._prefetch is not None:
             self._prefetch.destroy()
             self._prefetch = None
+        # close VFS-backed sources deterministically (a registered
+        # scheme's stream may hold an fd or remote connection; GC is
+        # too late for many-part many-epoch jobs)
+        for s in getattr(self, "_sources", []):
+            if hasattr(s, "close"):
+                try:
+                    s.close()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+        self._sources = []
+        self._files = []
 
     @staticmethod
     def _zero_copy_columns(table, names) -> Optional[List[np.ndarray]]:

@@ -78,9 +78,12 @@ class Stream:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    def as_file(self) -> "_StreamFile":
-        """Adapt to a Python binary-file-like object (reference dmlc::istream)."""
-        return _StreamFile(self)
+    def as_file(self, size: Optional[int] = None) -> "_StreamFile":
+        """Adapt to a Python binary-file-like object (reference
+        dmlc::istream). Pass the object's total ``size`` to enable
+        seek-from-end (whence=2) on SeekStreams — consumers like
+        pyarrow discover file size that way."""
+        return _StreamFile(self, size=size)
 
 
 class SeekStream(Stream):
@@ -180,8 +183,17 @@ class FileStream(SeekStream):
 class _StreamFile(_pyio.RawIOBase):
     """Binary file adapter over a Stream (reference dmlc::istream/ostream)."""
 
-    def __init__(self, stream: Stream):
+    def __init__(self, stream: Stream, size: Optional[int] = None):
         self._s = stream
+        self._size = size
+
+    def close(self) -> None:
+        # propagate to the underlying Stream (fd/socket/remote handle) —
+        # RawIOBase.close() alone would strand it until GC
+        try:
+            self._s.close()
+        finally:
+            super().close()
 
     def readable(self) -> bool:
         return True
@@ -207,8 +219,11 @@ class _StreamFile(_pyio.RawIOBase):
             self._s.seek(pos)
         elif whence == 1:
             self._s.seek(self._s.tell() + pos)
+        elif whence == 2 and self._size is not None:
+            self._s.seek(self._size + pos)
         else:
-            raise _pyio.UnsupportedOperation("seek from end")
+            raise _pyio.UnsupportedOperation(
+                "seek from end needs as_file(size=...)")
         return self._s.tell()
 
 

@@ -35,9 +35,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = [
-    "worker_envs", "init_from_env", "finalize", "launch_local",
-    "launch_ssh", "get_ring", "get_tree", "get_link_map", "find_free_port",
-    "main",
+    "worker_envs", "ps_envs", "get_role", "init_from_env", "finalize",
+    "launch_local", "launch_ssh", "get_ring", "get_tree", "get_link_map",
+    "find_free_port", "main",
 ]
 
 # env contract (reference: slave_envs in tracker.py)
@@ -88,12 +88,50 @@ def worker_envs(coordinator: str, num_workers: int,
     }
 
 
+def ps_envs(root_uri: str, root_port: int, num_workers: int,
+            num_servers: int, role: str,
+            task_id: Optional[int] = None) -> Dict[str, str]:
+    """The parameter-server half of the reference env contract
+    (reference: tracker.py PSTracker — DMLC_PS_ROOT_URI/PORT,
+    DMLC_ROLE in scheduler|server|worker, DMLC_NUM_SERVER/WORKER).
+
+    The TPU framework itself has no parameter-server architecture (XLA
+    collectives over ICI/DCN replace push/pull — SURVEY §5.8), but
+    PS-Lite-style DOWNSTREAM code launched through this tracker expects
+    these names; launch_local(num_servers=...) spawns the full role set
+    with this contract so such code finds its scheduler."""
+    check(role in ("scheduler", "server", "worker"),
+          f"unknown PS role {role!r}")
+    out = {
+        "DMLC_PS_ROOT_URI": root_uri,
+        "DMLC_PS_ROOT_PORT": str(root_port),
+        "DMLC_NUM_SERVER": str(num_servers),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_ROLE": role,
+    }
+    if task_id is not None:
+        out["DMLC_TASK_ID"] = str(task_id)
+    return out
+
+
+def get_role() -> str:
+    """This process's tracker role (reference: DMLC_ROLE). 'worker' when
+    unset — only launch_local(num_servers>0) / PS-style launchers create
+    the other roles. Branch on this BEFORE init_from_env: scheduler and
+    server processes are not part of the jax.distributed worker gang."""
+    return os.environ.get("DMLC_ROLE", "worker")
+
+
 def init_from_env(force: bool = False) -> Tuple[int, int]:
     """Worker-side rendezvous: jax.distributed.initialize from the env
     contract. Returns (process_id, num_processes). No-op (returning
     jax's current values) when the env is absent — single-process mode.
     """
     import jax
+    check(get_role() == "worker",
+          f"init_from_env joins the WORKER gang; this process is a "
+          f"{get_role()!r} (branch on get_role() first — PS scheduler/"
+          f"server processes run their own control plane)")
     coord = _getenv(ENV_COORD)
     if coord is None and not force:
         return jax.process_index(), jax.process_count()
@@ -123,37 +161,73 @@ def finalize() -> None:
 def launch_local(num_workers: int, command: Sequence[str],
                  env: Optional[Dict[str, str]] = None,
                  coordinator: Optional[str] = None,
-                 timeout: Optional[float] = None) -> List[int]:
+                 timeout: Optional[float] = None,
+                 num_servers: int = 0) -> List[int]:
     """Run N worker processes on this host (reference: local.py).
 
-    Returns the list of exit codes (order = task id). Raises if any
-    worker fails.
+    With ``num_servers > 0`` (reference: dmlc-submit --num-servers +
+    PSTracker), additionally spawns ONE scheduler and ``num_servers``
+    server processes running the same command under the PS env contract
+    (DMLC_PS_ROOT_URI/PORT, DMLC_ROLE) — the command branches on
+    ``get_role()``. Workers carry BOTH contracts; the jax gang is
+    workers-only.
+
+    Returns the list of exit codes (workers first in task-id order,
+    then scheduler, then servers). Raises if any process fails.
     """
     check(num_workers >= 1, "num_workers must be >= 1")
+    check(num_servers >= 0, "num_servers must be >= 0")
     if coordinator is None:
         coordinator = f"127.0.0.1:{find_free_port()}"
+    ps_root: Optional[Tuple[str, int]] = None
+    if num_servers > 0:
+        ps_root = ("127.0.0.1", find_free_port())
     import time as _time
-    procs = []
-    for task_id in range(num_workers):
-        wenv = dict(os.environ)
-        if env:
-            wenv.update(env)
-        wenv.update(worker_envs(coordinator, num_workers, task_id))
-        procs.append(subprocess.Popen(list(command), env=wenv))
-    deadline = _time.monotonic() + timeout if timeout else None
-    codes: List[Optional[int]] = []
-    try:
-        for p in procs:
-            remaining = (deadline - _time.monotonic()) if deadline else None
-            codes.append(p.wait(timeout=remaining))
-    except subprocess.TimeoutExpired:
+    procs: List[subprocess.Popen] = []
+
+    def _kill_gang() -> None:
         for p in procs:  # kill the whole gang, leak nothing
             if p.poll() is None:
                 p.kill()
         for p in procs:
             p.wait()
+
+    deadline = _time.monotonic() + timeout if timeout else None
+    codes: List[Optional[int]] = []
+    try:
+        # spawning sits INSIDE the guard: a Popen failure mid-loop
+        # (EAGAIN/ENOMEM — likelier with PS roles multiplying the
+        # process count) must not leak the already-running half of the
+        # gang blocked in rendezvous on the coordinator port
+        for task_id in range(num_workers):
+            wenv = dict(os.environ)
+            if env:
+                wenv.update(env)
+            wenv.update(worker_envs(coordinator, num_workers, task_id))
+            if ps_root is not None:
+                wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
+                                    num_servers, "worker", task_id))
+            procs.append(subprocess.Popen(list(command), env=wenv))
+        if ps_root is not None:
+            roles = [("scheduler", 0)] + [("server", i)
+                                          for i in range(num_servers)]
+            for role, task_id in roles:
+                renv = dict(os.environ)
+                if env:
+                    renv.update(env)
+                renv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
+                                    num_servers, role, task_id))
+                procs.append(subprocess.Popen(list(command), env=renv))
+        for p in procs:
+            remaining = (deadline - _time.monotonic()) if deadline else None
+            codes.append(p.wait(timeout=remaining))
+    except subprocess.TimeoutExpired:
+        _kill_gang()
         raise DMLCError(
             f"workers exceeded timeout {timeout}s; all killed") from None
+    except BaseException:
+        _kill_gang()
+        raise
     if any(codes):
         raise DMLCError(f"worker failure, exit codes {codes}")
     return codes
@@ -220,6 +294,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "(reference: dmlc-submit; TPU-native rendezvous)")
     ap.add_argument("--cluster", choices=["local", "ssh"], default="local")
     ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="PS server processes (reference: dmlc-submit "
+                         "--num-servers; spawns scheduler+servers under "
+                         "the DMLC_PS_* env contract, local cluster only)")
     ap.add_argument("--host-file", default=None,
                     help="one host per line (ssh cluster)")
     ap.add_argument("--coordinator", default=None,
@@ -229,8 +307,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     check(len(args.command) > 0, "no worker command given")
     cmd = args.command[1:] if args.command[0] == "--" else args.command
     if args.cluster == "local":
-        launch_local(args.num_workers, cmd, coordinator=args.coordinator)
+        launch_local(args.num_workers, cmd, coordinator=args.coordinator,
+                     num_servers=args.num_servers)
     else:
+        check(args.num_servers == 0,
+              "--num-servers is local-cluster only (ssh PS launch: set "
+              "the DMLC_PS_* env per host with ps_envs())")
         check(args.host_file is not None, "--host-file required for ssh")
         with open(args.host_file) as f:
             hosts = [h.strip() for h in f if h.strip()]

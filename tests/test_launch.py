@@ -77,6 +77,44 @@ class TestLocalLaunch:
         for r in range(3):
             assert (tmp_path / f"out-{r}").read_text() == "3"
 
+    def test_ps_roles_spawned_with_contract(self, tmp_path):
+        # VERDICT r4 #6: the PS-role half of the reference env contract
+        # (tracker.py PSTracker): --num-servers spawns one scheduler +
+        # N servers + workers, all sharing DMLC_PS_ROOT_URI/PORT, each
+        # branching on DMLC_ROLE. The command is ROLE-GENERIC, as a
+        # PS-Lite-style binary would be.
+        script = tmp_path / "node.py"
+        script.write_text(
+            "import os\n"
+            "role = os.environ.get('DMLC_ROLE', 'worker')\n"
+            "tid = os.environ.get('DMLC_TASK_ID', 'x')\n"
+            "line = ','.join([os.environ['DMLC_PS_ROOT_URI'],\n"
+            "                 os.environ['DMLC_PS_ROOT_PORT'],\n"
+            "                 os.environ['DMLC_NUM_SERVER'],\n"
+            "                 os.environ['DMLC_NUM_WORKER']])\n"
+            f"open(r'{tmp_path}' + f'/role-{{role}}-{{tid}}', 'w')"
+            ".write(line)\n")
+        codes = launch_local(2, [sys.executable, str(script)],
+                             num_servers=2)
+        assert codes == [0] * 5  # 2 workers + scheduler + 2 servers
+        names = sorted(p.name for p in tmp_path.glob("role-*"))
+        assert names == ["role-scheduler-0", "role-server-0",
+                         "role-server-1", "role-worker-0",
+                         "role-worker-1"]
+        # every role sees the SAME PS root and world sizes
+        contents = {(tmp_path / n).read_text() for n in names}
+        assert len(contents) == 1
+        uri, port, ns, nw = contents.pop().split(",")
+        assert uri == "127.0.0.1" and int(port) > 0
+        assert (ns, nw) == ("2", "2")
+
+    def test_ps_role_guard_in_init_from_env(self, monkeypatch):
+        # scheduler/server processes must not join the jax worker gang
+        from dmlc_tpu.parallel.launch import init_from_env
+        monkeypatch.setenv("DMLC_ROLE", "server")
+        with pytest.raises(DMLCError, match="WORKER gang"):
+            init_from_env()
+
     def test_worker_failure_raises(self, tmp_path):
         script = tmp_path / "bad.py"
         script.write_text("import sys; sys.exit(3)\n")

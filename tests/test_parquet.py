@@ -97,6 +97,80 @@ class TestParquetParser:
             block.label, table.column("label").to_numpy())
 
 
+class TestParquetOverVFS:
+    def test_registered_scheme_streams_parquet(self, parquet_file):
+        # VERDICT r4 #7: every text parser rides the Stream/VFS seam; so
+        # must Parquet. A scheme registered via FileSystem.register_scheme
+        # whose open_for_read returns a SeekStream must feed pyarrow
+        # through the as_file(size=...) adapter — no local-path escape.
+        path, table = parquet_file
+        from dmlc_tpu.io.filesys import (FileInfo, FileSystem,
+                                         LocalFileSystem, URI)
+
+        opened = []
+
+        class PrefixFS(LocalFileSystem):
+            """vfsx://<abs path> → local file, paths keep the scheme so
+            every re-dispatch stays inside the VFS."""
+
+            def open_for_read(self, uri):
+                opened.append(uri.name)
+                return super().open_for_read(URI(uri.name))
+
+            def open(self, uri, mode):
+                opened.append(uri.name)
+                return super().open(URI(uri.name), mode)
+
+            def get_path_info(self, uri):
+                info = super().get_path_info(URI(uri.name))
+                return FileInfo(path=f"vfsx://{info.path}",
+                                size=info.size, type=info.type)
+
+        FileSystem.register_scheme("vfsx://", PrefixFS)
+        try:
+            parser = Parser.create(f"vfsx://{path}", 0, 1,
+                                   format="parquet", label_column="label",
+                                   prefetch=False)
+            block = drain(parser)
+            assert block.size == 1000
+            np.testing.assert_array_equal(
+                block.label, table.column("label").to_numpy())
+            assert opened, "scheme open() was never exercised"
+        finally:
+            FileSystem._schemes.pop("vfsx://", None)
+            FileSystem._instances.pop("vfsx://", None)
+
+    def test_non_seekable_scheme_fails_with_guidance(self, parquet_file):
+        path, _ = parquet_file
+        from dmlc_tpu.io.filesys import FileInfo, FileSystem, URI
+        from dmlc_tpu.io.stream import FileStream, Stream
+
+        class NoSeekFS(FileSystem):
+            def open_for_read(self, uri):
+                f = open(URI(uri.name).name, "rb")
+                s = Stream()  # base Stream: not a SeekStream
+                s.read = lambda n: f.read(n)
+                s.close = f.close
+                return s
+
+            open = open_for_read
+
+            def get_path_info(self, uri):
+                import os
+                return FileInfo(path=f"noseek://{uri.name}",
+                                size=os.path.getsize(uri.name),
+                                type="file")
+
+        FileSystem.register_scheme("noseek://", NoSeekFS)
+        try:
+            with pytest.raises(Exception, match="seek|Seek"):
+                Parser.create(f"noseek://{path}", 0, 1, format="parquet",
+                              prefetch=False)
+        finally:
+            FileSystem._schemes.pop("noseek://", None)
+            FileSystem._instances.pop("noseek://", None)
+
+
 class TestNativeInterleave:
     """The native cache-blocked column interleave must be value-identical
     to the numpy fallback on every dtype/fallback combination."""
