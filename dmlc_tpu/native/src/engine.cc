@@ -1140,19 +1140,34 @@ inline void AuditCursorBounds(const CSRArena& a) {
 // deep instead of a whole slice later. (Per-TOKEN ic/vc checks stay
 // debug-only — that is the hot loop the raw cursors exist to keep
 // branch-free.)
-inline void CheckRowCursors(const CSRArena& a, const uint32_t* ic,
-                            const float* vc, const float* lc,
-                            const int64_t* oc,
-                            const int64_t* fc = nullptr) {
-  if (lc >= a.label.data() + a.label.cap ||
-      oc >= a.offset.data() + a.offset.cap ||
-      ic > a.index32.data() + a.index32.cap ||
-      vc > a.value.data() + a.value.cap ||
-      (fc && fc > a.field.data() + a.field.cap))
-    throw EngineError{
-        "internal: parse cursors overran their reserved capacity "
-        "(token-size invariant violated; please report)"};
-}
+// Hoisted row bounds: the cap END pointers are loop-invariant for a
+// slice (reserve() ran up-front; push_index widening never moves or
+// shrinks these buffers), but the compiler cannot prove that across the
+// *ic++/*vc++ stores, so the member-load form re-reads ~8 fields per
+// row. Kernels hoist the ends once and pay 4 register compares per row
+// (decomposition: row+arena was 1.9 ns/token of the a1a budget —
+// BASELINE.md "Short-token cycle budget").
+struct RowBounds {
+  const float* lc_end;
+  const int64_t* oc_end;
+  const uint32_t* ic_end;
+  const float* vc_end;
+  const int64_t* fc_end;
+  explicit RowBounds(const CSRArena& a)
+      : lc_end(a.label.data() + a.label.cap),
+        oc_end(a.offset.data() + a.offset.cap),
+        ic_end(a.index32.data() + a.index32.cap),
+        vc_end(a.value.data() + a.value.cap),
+        fc_end(a.field.data() + a.field.cap) {}
+  inline void check(const uint32_t* ic, const float* vc, const float* lc,
+                    const int64_t* oc, const int64_t* fc = nullptr) const {
+    if (lc >= lc_end || oc >= oc_end || ic > ic_end || vc > vc_end ||
+        (fc && fc > fc_end))
+      throw EngineError{
+          "internal: parse cursors overran their reserved capacity "
+          "(token-size invariant violated; please report)"};
+  }
+};
 
 // THE fixed-6-decimal value classifier, shared by the kernel fast path
 // and the dispatcher probe so the two can never drift apart: vw is
@@ -1188,6 +1203,11 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
   float* lc = a->label.data() + a->label.size();
   int64_t* oc = a->offset.data() + a->offset.size();
   int64_t off = oc[-1];  // arena invariant: offset always starts {0}
+  const RowBounds bounds(*a);
+  // local mirror of a->wide: the per-token member load in the hot path
+  // cannot be register-cached by the compiler (the *ic/*vc stores may
+  // alias it); only push_index can flip it, so refresh at those sites
+  bool wide = a->wide;
   // Single pass, no line-end pre-scan: rows are delimited by the token
   // loop itself hitting a newline. Row-per-line semantics are preserved
   // because every token scan stops at '\n'/'\r' and the next row starts
@@ -1230,7 +1250,6 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
     }
     int64_t qid = -1;
     size_t row_nnz = 0;
-    bool seen_feature = false;
     // Feature tokens parse index digits in the same pass as the token
     // scan. Note this splits at the FIRST colon while the reference
     // splits at the LAST — equivalent, because the index is all-digits:
@@ -1275,7 +1294,7 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
                            : (p == 2 ? d0 * 10 + d1
                                      : d0 * 100 + d1 * 10 + d2);
             float val = (float)((p == 1) ? d2 : (p == 2 ? d3 : d4));
-            if (!a->wide) {
+            if (!wide) {
               DTP_DCHECK(ic < a->index32.data() + a->index32.cap);
               *ic++ = (uint32_t)idx;
             } else {
@@ -1286,7 +1305,6 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
             DTP_DCHECK(vc < a->value.data() + a->value.cap);
             *vc++ = val;
             ++row_nnz;
-            seen_feature = true;
             // consume a single-space separator here: the next
             // iteration's ws-skip then starts on a non-ws byte (one
             // failed test instead of taken+failed — measurable at
@@ -1347,7 +1365,7 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
         const char* tok_end = s;
         while (tok_end < e && !is_ws(*tok_end) && !is_nl(*tok_end))
           ++tok_end;
-        if (!seen_feature && tok_end - q > 4 &&
+        if (row_nnz == 0 && tok_end - q > 4 &&
             std::memcmp(q, "qid:", 4) == 0) {
           if (!parse_i64(q + 4, tok_end, &qid))
             throw EngineError{"libsvm: bad qid token '" +
@@ -1404,7 +1422,7 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
           }
         }
       }
-      if (!a->wide && idx <= UINT32_MAX) {
+      if (!wide && idx <= UINT32_MAX) {
         // unchecked write: capacity bounded by the bytes/4+1 reserve
         // above, valid while every feature token is >=4 bytes incl.
         // separator ("i:v "). If that invariant is ever relaxed (e.g.
@@ -1416,16 +1434,16 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
         // rare >u32 index: sync cursor, widen, continue via checked path
         a->index32.n = (size_t)(ic - a->index32.data());
         a->push_index(idx);
+        wide = a->wide;  // push_index may have widened the arena
         ic = a->index32.data() + a->index32.size();  // stays synced when wide
       }
       DTP_DCHECK(vc < a->value.data() + a->value.cap);
       *vc++ = val;
       ++row_nnz;
-      seen_feature = true;
       q = s;
     }
     p = q;
-    CheckRowCursors(*a, ic, vc, lc, oc);
+    bounds.check(ic, vc, lc, oc);
     *lc++ = label;
     off += (int64_t)row_nnz;
     *oc++ = off;
@@ -1433,7 +1451,7 @@ void ParseLibSVMSliceImpl(const char* b, const char* e, CSRArena* a) {
   }
   a->label.n = (size_t)(lc - a->label.data());
   a->offset.n = (size_t)(oc - a->offset.data());
-  if (!a->wide) a->index32.n = (size_t)(ic - a->index32.data());
+  if (!wide) a->index32.n = (size_t)(ic - a->index32.data());
   a->value.n = (size_t)(vc - a->value.data());
   AuditCursorBounds(*a);
 }
@@ -1517,6 +1535,7 @@ void ParseCSVSliceImpl(const char* b, const char* e,
   float* lc = a->label.data() + a->label.size();
   int64_t* oc = a->offset.data() + a->offset.size();
   int64_t off = oc[-1];  // arena invariant: offset always starts {0}
+  const RowBounds bounds(*a);
   const bool want_weight = cfg.weight_column >= 0;
   // single pass, no line-end pre-scan (same structure as libsvm above)
   const char* p = b;
@@ -1618,7 +1637,7 @@ void ParseCSVSliceImpl(const char* b, const char* e,
       a->max_index = std::max(
           a->max_index, (uint64_t)(kSparse ? row_max : fidx - 1));
     }
-    CheckRowCursors(*a, ic, vc, lc, oc);
+    bounds.check(ic, vc, lc, oc);
     *lc++ = label;
     off += (int64_t)row_nnz;
     *oc++ = off;
@@ -1679,6 +1698,7 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
   float* lc = a->label.data() + a->label.size();
   int64_t* oc = a->offset.data() + a->offset.size();
   int64_t off = oc[-1];  // arena invariant: offset always starts {0}
+  const RowBounds bounds(*a);
   const char* p = b;
   while (p < e) {
     while (p < e && (is_nl(*p) || is_ws(*p))) ++p;
@@ -1804,7 +1824,7 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
     }
     p = q;
     a->has_field = true;
-    CheckRowCursors(*a, ic, vc, lc, oc, fc);
+    bounds.check(ic, vc, lc, oc, fc);
     *lc++ = label;
     off += (int64_t)row_nnz;
     *oc++ = off;

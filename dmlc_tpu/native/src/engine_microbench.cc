@@ -188,6 +188,139 @@ static double run(const char* name, const std::string& data, int iters,
   return best / (double)a.rows();  // seconds per row
 }
 
+// ---------------------------------------------------------------------
+// Short-token budget decomposition (VERDICT r4 #4): peel the a1a
+// short-token kernel into cumulative stages and time each on the SAME
+// corpus in ONE process run (stages are only comparable within a run on
+// this credit-throttled host). The stages:
+//   A  sequential 8-byte touch of the corpus     (memory floor)
+//   B  + token scan: ws-skip, load8, parallel-compare width classify,
+//        cursor advance (the loop-carried dependency chain)
+//   C  + index/value computation (arithmetic off the classified bytes)
+//   D  + raw stores of index/value (the kernel's commit work)
+// The full kernel (printed alongside) adds row turnaround (label,
+// offset, row-bounds check) and arena bookkeeping on top of D.
+// Findings live in BASELINE.md "Short-token cycle budget".
+
+static uint32_t g_ibuf[1 << 24];
+static float g_vbuf[1 << 24];
+
+static uint64_t stage_touch(const std::string& s) {
+  uint64_t h = 0;
+  const char* p = s.data();
+  const char* e = p + s.size();
+  for (; p + 8 <= e; p += 8) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    h ^= w;
+  }
+  return h;
+}
+
+// kC: 0 = scan only, 1 = +compute, 2 = +stores. One template so every
+// stage shares IDENTICAL control flow — the deltas isolate data work.
+template <int kC>
+static uint64_t stage_scan(const std::string& sdat) {
+  const char* p = sdat.data();
+  const char* e = p + sdat.size();
+  uint64_t h = 0;
+  uint32_t* ic = g_ibuf;
+  float* vc = g_vbuf;
+  while (p < e) {
+    while (p < e && (is_nl(*p) || is_ws(*p))) ++p;
+    if (p >= e) break;
+    while (p < e && !is_ws(*p) && !is_nl(*p)) ++p;  // label skip
+    const char* q = p;
+    while (true) {
+      while (q < e && is_ws(*q)) ++q;
+      if (q >= e || is_nl(*q)) break;
+      uint64_t w8 = load8(q, e);
+      unsigned b1 = (unsigned)(w8 >> 8) & 0xff;
+      unsigned b2 = (unsigned)(w8 >> 16) & 0xff;
+      unsigned b3 = (unsigned)(w8 >> 24) & 0xff;
+      unsigned d0 = ((unsigned)(w8)&0xff) - '0';
+      unsigned d1 = b1 - '0', d2 = b2 - '0', d3 = b3 - '0';
+      unsigned d4 = ((unsigned)(w8 >> 32) & 0xff) - '0';
+      bool v1 = (d0 <= 9) & (b1 == ':') & (d2 <= 9);
+      bool v2 = (d0 <= 9) & (d1 <= 9) & (b2 == ':') & (d3 <= 9);
+      bool v3 = (d0 <= 9) & (d1 <= 9) & (d2 <= 9) & (b3 == ':') &
+                (d4 <= 9);
+      int w = v1 ? 1 : (v2 ? 2 : (v3 ? 3 : 0));
+      if (!w) {  // non-short token: generic skip (rare on a1a)
+        while (q < e && !is_ws(*q) && !is_nl(*q)) ++q;
+        continue;
+      }
+      if (kC >= 1) {
+        uint64_t idx = (w == 1) ? d0
+                       : (w == 2 ? d0 * 10 + d1 : d0 * 100 + d1 * 10 + d2);
+        float val = (float)((w == 1) ? d2 : (w == 2 ? d3 : d4));
+        if (kC >= 2) {
+          *ic++ = (uint32_t)idx;
+          *vc++ = val;
+        } else {
+          h += idx + (uint64_t)val;
+        }
+      } else {
+        h += (unsigned)w;
+      }
+      const char* tend = q + w + 2;
+      q = (tend < e && *tend == ' ') ? tend + 1 : tend;
+    }
+    p = q;
+  }
+  return h + (uint64_t)(ic - g_ibuf);
+}
+
+static void decompose(int iters, size_t mb) {
+  std::string a1a = make_a1a(mb << 20);
+  size_t ntok = 0;
+  {  // token count for the ns/token scale
+    CSRArena a;
+    ParseLibSVMSlice(a1a.data(), a1a.data() + a1a.size(), &a);
+    ntok = a.nnz();
+  }
+  struct Row {
+    const char* name;
+    double best;
+  };
+  auto time_fn = [&](auto fn) {
+    volatile uint64_t sink = 0;
+    double best = 1e30;
+    for (int it = 0; it < iters; ++it) {
+      auto t0 = std::chrono::steady_clock::now();
+      sink += fn();
+      auto t1 = std::chrono::steady_clock::now();
+      double dt = std::chrono::duration<double>(t1 - t0).count();
+      if (dt < best) best = dt;
+    }
+    (void)sink;
+    return best;
+  };
+  double tA = time_fn([&] { return stage_touch(a1a); });
+  double tB = time_fn([&] { return stage_scan<0>(a1a); });
+  double tC = time_fn([&] { return stage_scan<1>(a1a); });
+  double tD = time_fn([&] { return stage_scan<2>(a1a); });
+  CSRArena a;
+  double tF = time_fn([&] {
+    a.clear();
+    ParseLibSVMSlice(a1a.data(), a1a.data() + a1a.size(), &a);
+    return (uint64_t)a.nnz();
+  });
+  auto line = [&](const char* n, double t) {
+    std::printf("%-34s %7.3f GB/s  %6.2f ns/token\n", n,
+                a1a.size() / t / 1e9, t * 1e9 / (double)ntok);
+  };
+  line("A touch (memory floor)", tA);
+  line("B +scan/classify/advance", tB);
+  line("C +index/value compute", tC);
+  line("D +stores", tD);
+  line("F full kernel (rows, arena)", tF);
+  std::printf("deltas ns/token: scan-chain %.2f, compute %.2f, stores "
+              "%.2f, row+arena %.2f\n",
+              (tB - tA) * 1e9 / ntok, (tC - tB) * 1e9 / ntok,
+              (tD - tC) * 1e9 / ntok, (tF - tD) * 1e9 / ntok);
+}
+
 // per-row fixed-cost accounting (VERDICT r3 #3): same token shape,
 // rows of k1 vs k2 tokens; t/row = B + k*T solves for B (row
 // turnaround: label parse, offset write, loop resets) and T (token)
@@ -208,11 +341,26 @@ static void row_cost_accounting(int iters, size_t mb) {
 }
 
 int main(int argc, char** argv) {
+  if (argc > 1 && std::string(argv[1]) == "--decompose") {
+    int iters = argc > 2 ? std::atoi(argv[2]) : 9;
+    long mb_arg = argc > 3 ? std::atol(argv[3]) : 32;
+    // stage_scan<2> writes one entry per token into the fixed g_ibuf/
+    // g_vbuf (1<<24 entries); a1a runs ~5.5 bytes/token, so 64 MB is
+    // the safe ceiling for this mode
+    if (iters < 1 || mb_arg < 1 || mb_arg > 64) {
+      std::fprintf(stderr,
+                   "usage: %s --decompose [iters] [mb<=64]\n", argv[0]);
+      return 2;
+    }
+    decompose(iters, (size_t)mb_arg);
+    return 0;
+  }
   int iters = argc > 1 ? std::atoi(argv[1]) : 7;
   long mb_arg = argc > 2 ? std::atol(argv[2]) : 48;
   if (iters < 1 || mb_arg < 1 || mb_arg > 4096) {
-    std::fprintf(stderr, "usage: %s [iters>=1] [mb_per_corpus 1..4096]\n",
-                 argv[0]);
+    std::fprintf(stderr, "usage: %s [iters>=1] [mb_per_corpus 1..4096] "
+                 "| %s --decompose [iters] [mb]\n",
+                 argv[0], argv[0]);
     return 2;
   }
   size_t mb = (size_t)mb_arg;
