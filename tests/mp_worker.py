@@ -71,7 +71,12 @@ def main() -> int:
         # count host collectives per epoch: epoch 1 agrees on the round
         # count (one done-flag allgather per round), later epochs must
         # run with ZERO per-batch collectives (VERDICT r2 #3 — the
-        # reference has no cross-worker comm at all during iteration)
+        # reference has no cross-worker comm at all during iteration).
+        # 3 epochs since r5: epoch 2+ may REPLAY retained rounds (or
+        # re-parse when this rank's budget forbids caching — ranks may
+        # MIX paths, both are collective-free and batch-identical); the
+        # per-epoch local-shard digest proves every epoch served the
+        # same bytes whichever path produced them.
         from jax.experimental import multihost_utils
         orig_ag = multihost_utils.process_allgather
         ag_calls = [0]
@@ -85,15 +90,23 @@ def main() -> int:
         last_loss = None
         epoch_batches = []
         epoch_collectives = []
+        epoch_digests = []
         try:
-            for _epoch in range(2):
+            for _epoch in range(3):
                 nb0, ag0 = nbatches, ag_calls[0]
+                eh = hashlib.sha256()
                 for batch in it:
+                    for key in sorted(batch):  # EVERY field, incl. the
+                        # weight column and the num_rows/num_nnz true-
+                        # size masks — "same bytes" must mean all of them
+                        for sh in batch[key].addressable_shards:
+                            eh.update(np.asarray(sh.data).tobytes())
                     params, loss = step_fn(params, batch)
                     nbatches += 1
                     last_loss = float(loss)
                 epoch_batches.append(nbatches - nb0)
                 epoch_collectives.append(ag_calls[0] - ag0)
+                epoch_digests.append(eh.hexdigest())
         finally:
             multihost_utils.process_allgather = orig_ag
         ck.save(nbatches, params, metadata={"nbatches": nbatches})
@@ -101,6 +114,8 @@ def main() -> int:
                   "loss": last_loss, "params_digest": digest(params),
                   "epoch_batches": epoch_batches,
                   "epoch_collectives": epoch_collectives,
+                  "epoch_digests": epoch_digests,
+                  "replay_epochs": it.replay_epochs,
                   "w_head": np.asarray(params["w"])[:8].tolist()}
     elif phase == "restore":
         restored, user = ck.restore(like=params)

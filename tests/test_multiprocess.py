@@ -91,6 +91,17 @@ class TestMultiProcessDistributed:
             assert r["epoch_collectives"][0] >= r["epoch_batches"][0], \
                 f"expected per-round cadence: {r['epoch_collectives']}"
             assert r["epoch_collectives"][1] == 0
+            assert r["epoch_collectives"][2] == 0
+            # every epoch served identical bytes per rank, whichever
+            # path (re-parse or teed replay) produced them
+            assert len(set(r["epoch_digests"])) == 1, r["epoch_digests"]
+        # rank 0 (budget 0) can never tee a replay cache; rank 1 tees
+        # during epoch 2's re-parse and REPLAYS epoch 3 — MIXED paths
+        # must stay in lockstep (no collectives in either), which the
+        # batch-count and digest asserts above prove. Pin both sides so
+        # the mixed scenario cannot silently stop being exercised.
+        assert results[0]["replay_epochs"] == 0
+        assert results[1]["replay_epochs"] == 1, results[1]["replay_epochs"]
 
     def test_two_process_train_matches_single_process(self, skewed_file,
                                                       tmp_path):
@@ -122,11 +133,17 @@ class TestMultiProcessDistributed:
         # steady-state epochs run with zero per-batch collectives
         # (VERDICT r2 #3) and identical batch cadence
         for r in mp_results:
-            assert r["epoch_batches"][0] == r["epoch_batches"][1]
+            assert (r["epoch_batches"][0] == r["epoch_batches"][1]
+                    == r["epoch_batches"][2])
             assert r["epoch_collectives"][0] == 1, \
                 f"epoch 1 should agree in ONE collective: {r['epoch_collectives']}"
-            assert r["epoch_collectives"][1] == 0, \
+            assert r["epoch_collectives"][1:] == [0, 0], \
                 f"steady-state epoch ran collectives: {r['epoch_collectives']}"
+            # r5 steady replay: the cached epoch-1 pass commits the
+            # rounds, so BOTH steady epochs serve from memory with the
+            # exact epoch-1 bytes (per-rank local-shard digest)
+            assert r["replay_epochs"] == 2, r["replay_epochs"]
+            assert len(set(r["epoch_digests"])) == 1, r["epoch_digests"]
         # identical training result (same parts, same order, same psums)
         assert mp_results[0]["params_digest"] == mp_results[1]["params_digest"]
         np.testing.assert_allclose(mp_results[0]["w_head"], sp["w_head"],
