@@ -21,7 +21,7 @@ C++ engine (used when built). Parity contract: decimal float parsing is
 "nearest double, then cast to float32" on both paths.
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 from dmlc_tpu.utils.logging import DMLCError, check, log_info, log_warning, log_error, log_fatal
 from dmlc_tpu.utils.registry import Registry
