@@ -5,13 +5,24 @@ criteo-shaped libsvm (one shard — per-chip throughput is the metric;
 the multi-part/multi-host shard shape is bench_suite config 4, which
 runs all parts with concurrent pipelines) → native C++ parse → zero-copy
 CSR views → async jax.device_put into device memory, transfers riding
-under parse via detached leases. Prints exactly ONE JSON line:
-{"metric", "value", "unit", "vs_baseline", "best_epoch", "epochs",
-"bound", "parse_cpu_gbps_core", "sustained_gauge_ok", "gauge_ok_epochs",
-"gauge_ok_threshold", "epoch_gauges", "replay_gbps"} — "value" is the
-SUSTAINED rate (20%-trimmed mean of per-epoch GB/s over >= 5 epochs /
->= the time budget), "best_epoch" the fastest single epoch,
-"parse_cpu_gbps_core" the thread-CPU parse rate (immune to this
+under parse via detached leases.
+
+The measured config is BUILT from the declarative pipeline graph
+(dmlc_tpu.pipeline): ``from_uri(...).parse(...).to_device(...)``
+compiles to the same parser + windowed async-transfer machinery the
+pre-r6 hand-wired loop used, with a telemetry probe at each stage
+boundary and the in-flight device window owned by the between-epoch
+autotuner instead of a hard-coded constant. A short hand-wired
+reference run (DMLC_TPU_BENCH_HANDWIRED_EPOCHS, default 3) reports
+"handwired_gbps" alongside so pipeline overhead stays visible.
+
+Prints exactly ONE JSON line: {"metric", "value", "unit",
+"vs_baseline", "best_epoch", "epochs", "bound", "parse_cpu_gbps_core",
+"sustained_gauge_ok", "gauge_ok_epochs", "gauge_ok_threshold",
+"epoch_gauges", "replay_gbps", "handwired_gbps", "pipeline"} —
+"value" is the SUSTAINED rate (20%-trimmed mean of per-epoch GB/s over
+>= 5 epochs / >= the time budget), "best_epoch" the fastest single
+epoch, "parse_cpu_gbps_core" the thread-CPU parse rate (immune to this
 burstable VM's credit scheduler), "sustained_gauge_ok" the same
 trimmed mean restricted to epochs whose pre-epoch host-memcpy gauge
 cleared "gauge_ok_threshold" (credit-healthy epochs only — the
@@ -19,8 +30,9 @@ cross-run-comparable number; per-epoch gauges ride in "epoch_gauges"),
 "replay_gbps" the parse-once/replay-epochs page rate in
 text-equivalent GB/s (the repeated-epoch training shape; "value"
 deliberately excludes it), "bound" whether the best epoch waited
-mainly on transfers or on parse, and vs_baseline is value / 2.0 (the
-BASELINE.json target of 2 GB/s/chip; the reference publishes no
+mainly on transfers or on parse, "pipeline" the best epoch's per-stage
+stats snapshot + the autotune report, and vs_baseline is value / 2.0
+(the BASELINE.json target of 2 GB/s/chip; the reference publishes no
 numbers of its own, see BASELINE.md).
 
 Secondary diagnostics go to stderr.
@@ -101,46 +113,65 @@ def main() -> None:
     # 8 MB (device_chunks ~0.2 GB/s vs 1.28 at 4 MB; bench sustained
     # 0.40 vs 0.54 GB/s for 8 vs 4 MB chunks on the same chip)
     chunk_mb = int(os.environ.get("DMLC_TPU_BENCH_CHUNK_MB", "4"))
-    parser = Parser.create(DATA, 0, 1, format="libsvm", engine="auto",
-                           chunk_size=chunk_mb << 20)
 
-    def epoch():
+    # Hand-wired reference config (pre-r6 measurement loop): parser →
+    # fixed 4-deep async device_put window with leased arenas. Run a
+    # few epochs of it so the pipeline-built path below stays honest.
+    def handwired_epoch(parser):
         parser.before_first()
         t0 = time.perf_counter()
-        rows = nnz = 0
         in_flight = []  # (future, lease): lease released after transfer
-        t_pull = 0.0   # waiting on the parser (parse-bound symptom)
-        t_xfer = 0.0   # waiting on device transfers (transfer-bound)
-        tp0 = time.perf_counter()
         while parser.next():
-            t_pull += time.perf_counter() - tp0
             block = parser.value()
-            rows += block.size
-            nnz += block.nnz
-            # parse-to-HBM: ship the CSR views to the device, async; the
-            # lease keeps the arena alive until the transfer completes
-            # (zero-copy: no astype/copy round on the ABI boundary)
             lease = parser.detach() if hasattr(parser, "detach") else None
             in_flight.append((jax.device_put(
                 {"offset": block.offset, "label": block.label,
                  "index": block.index, "value": block.value}, dev), lease))
             if len(in_flight) > 4:
                 fut, ls = in_flight.pop(0)
-                tx0 = time.perf_counter()
                 jax.block_until_ready(fut)
-                t_xfer += time.perf_counter() - tx0
                 if ls is not None:
                     ls.release()
-            tp0 = time.perf_counter()
         for fut, ls in in_flight:
-            tx0 = time.perf_counter()
             jax.block_until_ready(fut)
-            t_xfer += time.perf_counter() - tx0
             if ls is not None:
                 ls.release()
-        stats = parser.stats() if hasattr(parser, "stats") else None
-        return (time.perf_counter() - t0, t_pull, t_xfer, rows, nnz,
-                stats)
+        return time.perf_counter() - t0
+
+    handwired_gbps = None
+    hw_epochs = int(os.environ.get("DMLC_TPU_BENCH_HANDWIRED_EPOCHS", "3"))
+    if hw_epochs > 0:
+        hw_parser = Parser.create(DATA, 0, 1, format="libsvm",
+                                  engine="auto", chunk_size=chunk_mb << 20)
+        hw_walls = [handwired_epoch(hw_parser) for _ in range(hw_epochs)]
+        if hasattr(hw_parser, "destroy"):
+            hw_parser.destroy()
+        handwired_gbps = round(size / min(hw_walls) / 1e9, 4)
+        log(f"hand-wired reference: best of {hw_epochs} epochs = "
+            f"{handwired_gbps} GB/s")
+
+    # The measured config, built from the declarative graph: same
+    # parser, same windowed async transfer — but probed per stage and
+    # with the in-flight window an autotuner knob instead of the
+    # constant 4 the hand-wired loop carried.
+    from dmlc_tpu.pipeline import Pipeline
+    built = (Pipeline.from_uri(DATA)
+             .parse(format="libsvm", engine="auto",
+                    chunk_size=chunk_mb << 20)
+             .to_device(dev, window="auto")
+             .build(autotune=True))
+
+    def epoch():
+        for _ in built:
+            pass
+        snap = built.stats()
+        parse_st = snap["stages"][0]
+        dev_st = snap["stages"][-1]
+        t_pull = parse_st["wait_s"]
+        t_xfer = (dev_st.get("extra") or {}).get("xfer_wait_s", 0.0)
+        stats = (parse_st.get("extra") or {}).get("engine")
+        return (snap["wall_s"], t_pull, t_xfer, parse_st["rows"],
+                parse_st["nnz"], stats, snap)
 
     # Sustained measurement (VERDICT r2 #2): run at least min_epochs
     # passes AND keep sampling for the full time budget, then report the
@@ -170,17 +201,19 @@ def main() -> None:
     best = None
     best_stats = None
     best_waits = (0.0, 0.0)
+    best_snap = None
     t_start = time.perf_counter()
     i = 0
     while True:
         gauge = memcpy_gauge()
-        dt, t_pull, t_xfer, rows, nnz, stats = epoch()
+        dt, t_pull, t_xfer, rows, nnz, stats, snap = epoch()
         times.append((dt, gauge))
         log(f"epoch {i}: rows={rows} nnz={nnz} wall={dt:.2f}s "
             f"pull-wait={t_pull:.2f}s xfer-wait={t_xfer:.2f}s "
             f"gauge={gauge:.2f} -> {size / dt / 1e9:.3f} GB/s")
         if best is None or dt < best:
             best, best_stats, best_waits = dt, stats, (t_pull, t_xfer)
+            best_snap = snap
         i += 1
         elapsed = time.perf_counter() - t_start
         if i >= min_epochs and elapsed > budget_s:
@@ -209,8 +242,12 @@ def main() -> None:
         line = format_stages(best_stats, size)
         if line:
             log(line)
-    if hasattr(parser, "destroy"):
-        parser.destroy()
+    autotune_report = built.autotune_report()
+    built.close()
+    if autotune_report:
+        log(f"autotune: values={autotune_report['values']} "
+            f"tuned={autotune_report['tuned']} "
+            f"decisions={len(autotune_report['decisions'])}")
 
     # Page-replay rate (VERDICT r4 #2): the repeated-epoch training
     # shape — parse once into binary pages, replay pages → HBM on every
@@ -267,6 +304,18 @@ def main() -> None:
         # parse-once/replay-epochs rate in text-equivalent GB/s (the
         # repeated-epoch training shape); null if the probe failed
         "replay_gbps": replay_gbps,
+        # the pre-r6 hand-wired loop's best-of-N reference (null when
+        # DMLC_TPU_BENCH_HANDWIRED_EPOCHS=0): the pipeline-built path
+        # above must not sit below it
+        "handwired_gbps": handwired_gbps,
+        # the pipeline-built config's best epoch, per stage (schema:
+        # dmlc_tpu.pipeline.stats) + the between-epoch autotune report
+        # — the in-flight device window is tuner-owned, not a constant
+        "pipeline": {
+            "stages": best_snap["stages"] if best_snap else None,
+            "knobs": best_snap["knobs"] if best_snap else None,
+            "autotune": autotune_report,
+        },
     }))
 
 

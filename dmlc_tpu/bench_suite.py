@@ -20,6 +20,10 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                cadence (steady-state vs agreement epoch)
   8. page_replay — binary page cache replay → device HBM, parse
                skipped (DiskRowIter pages; the repeated-epoch shape)
+  9. pipeline — declarative Pipeline graph (dmlc_tpu.pipeline) lowered
+               onto the config-1 machinery: parse → batch → prefetch
+               with per-stage telemetry and autotuned depths,
+               content-hash parity vs the direct parse
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 """
@@ -628,6 +632,47 @@ def bench_page_replay(mb: int, rows_per_page: int = 8 << 10) -> Dict:
             "hash": replay_hash}
 
 
+def bench_pipeline(mb: int) -> Dict:
+    """Declarative pipeline config (r6): the same criteo-shaped corpus
+    as config 4, run through Pipeline.from_uri → parse → batch →
+    prefetch (dmlc_tpu.pipeline). Three epochs let the between-epoch
+    autotuner act; the stage snapshot of the best epoch and the
+    autotune report ride in the JSON. Parity: the pipeline's block
+    stream concatenates to the SAME content hash as a direct parse
+    (batching must not change content)."""
+    from dmlc_tpu.data.rowblock import RowBlockContainer
+    from dmlc_tpu.pipeline import Pipeline
+
+    path = f"{_TMP}.criteo.libsvm"
+    size = make_libsvm(path, mb, seed=7, nnz_range=(25, 45),
+                       index_space=10 ** 6, real_values=True)
+    built = (Pipeline.from_uri(path)
+             .parse(format="libsvm", engine="auto")
+             .batch(16 << 10)
+             .prefetch(depth="auto")
+             .build(autotune=True))
+    snaps = [built.run_epoch() for _ in range(3)]
+    best = min(s["wall_s"] for s in snaps)
+    best_snap = min(snaps, key=lambda s: s["wall_s"])
+    # parity pass (untimed): pipeline stream == direct parse, CSR-wise
+    c = RowBlockContainer(np.uint32)
+    for b in built:
+        c.push_block(b)
+    pipe_hash = c.get_block().content_hash()
+    report = built.autotune_report()
+    built.close()
+    parse_hash = _content_hash(path, "libsvm")
+    assert pipe_hash == parse_hash, \
+        f"pipeline diverged from direct parse: {pipe_hash} != {parse_hash}"
+    return {"config": "pipeline_libsvm", "gbps": size / best / 1e9,
+            "bytes": size, "rows": best_snap["stages"][-1]["rows"],
+            "epoch_walls": [round(s["wall_s"], 3) for s in snaps],
+            "stages": best_snap["stages"],
+            "knobs": best_snap["knobs"],
+            "autotune": report,
+            "hash": pipe_hash}
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -637,13 +682,14 @@ CONFIGS = {
     6: ("indexed_shuffled", lambda mb, dev: bench_indexed_shuffled(mb)),
     7: ("multiprocess", lambda mb, dev: bench_multiprocess_ingest(mb)),
     8: ("page_replay", lambda mb, dev: bench_page_replay(mb)),
+    9: ("pipeline", lambda mb, dev: bench_pipeline(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-8 (0 = all)")
+                    help="1-9 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -657,10 +703,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         _log(f"— config {n} ({name}), ~{args.mb} MB —")
         try:
             # config 7's steady-state metric already self-warms (epochs
-            # 2-3 of one gang) and config 8 takes best-of-3 replay
-            # epochs over a build it performs itself — a second full run
-            # of either would be pure wasted minutes
-            if not args.cold and n not in (7, 8):
+            # 2-3 of one gang), config 8 takes best-of-3 replay epochs
+            # over a build it performs itself, and config 9 runs three
+            # epochs of one pipeline — a second full run of any would
+            # be pure wasted minutes
+            if not args.cold and n not in (7, 8, 9):
                 fn(args.mb, args.device)  # warm imports + page cache
             out = fn(args.mb, args.device)
             out["gbps"] = round(out["gbps"], 4)

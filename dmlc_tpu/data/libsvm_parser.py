@@ -45,11 +45,21 @@ class LibSVMParser(TextParserBase):
             toks = line.split()
             if not toks:
                 continue
-            label = parse_float32(toks[0])
+            try:
+                label = parse_float32(toks[0])
+            except ValueError as e:
+                # engine parity: the native engine reports a bad label
+                # as DMLCError; a raw ValueError would also escape the
+                # replay-mutation wrapping in parallel/sharded.py
+                raise DMLCError(f"libsvm: bad label {toks[0]!r}") from e
             qid = -1
             feats = toks[1:]
             if feats and feats[0].startswith(b"qid:"):
-                qid = parse_index(feats[0][4:])
+                try:
+                    qid = parse_index(feats[0][4:])
+                except ValueError as e:
+                    raise DMLCError(
+                        f"libsvm: bad qid token {feats[0]!r}") from e
                 feats = feats[1:]
             idxs = np.empty(len(feats), np.uint64)
             vals = np.empty(len(feats), np.float32)
@@ -57,8 +67,12 @@ class LibSVMParser(TextParserBase):
                 i, sep, v = t.rpartition(b":")
                 if not sep:
                     raise DMLCError(f"libsvm: bad feature token {t!r}")
-                idxs[j] = parse_uint64(i)
-                vals[j] = parse_float32(v)
+                try:
+                    idxs[j] = parse_uint64(i)
+                    vals[j] = parse_float32(v)
+                except ValueError as e:
+                    raise DMLCError(
+                        f"libsvm: bad feature token {t!r}") from e
             if len(idxs):
                 m = int(idxs.min())
                 block_min = m if block_min is None else min(block_min, m)

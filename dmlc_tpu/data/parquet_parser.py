@@ -94,9 +94,11 @@ class ParquetParser(Parser):
         lp = local_path(path)
         if os.path.isfile(lp):
             return lp
+        # the adapter is handed off to pyarrow and nothing else holds
+        # the stream: transfer ownership so closing the file closes it
         stream = create_stream(path, "r")
         raw = stream.as_file(size=size if isinstance(stream, SeekStream)
-                             else None)
+                             else None, own_stream=True)
         return _io.BufferedReader(raw, buffer_size=1 << 20)
 
     # -- producer hooks (run on the prefetch thread)

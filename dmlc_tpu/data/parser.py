@@ -41,17 +41,27 @@ def native_or(native_cls_name: str, python_cls, kwargs):
     engine="python": golden only.
     """
     engine = kwargs.get("engine", "auto")
-    if engine in ("auto", "native"):
+    # python-only construction kwargs (pipeline seam): the native engine
+    # runs its own reader/queue pipeline, so a custom split forces the
+    # python golden and the chunk-prefetch depth simply does not apply
+    has_custom_split = kwargs.get("split_factory") is not None
+    if engine in ("auto", "native") and not has_custom_split:
         from dmlc_tpu.native import native_available
         if native_available():
             try:
                 from dmlc_tpu.native import bindings
-                return getattr(bindings, native_cls_name)(**kwargs)
+                nat_kwargs = {k: v for k, v in kwargs.items()
+                              if k not in ("prefetch_depth",
+                                           "split_factory")}
+                return getattr(bindings, native_cls_name)(**nat_kwargs)
             except (DMLCError, FileNotFoundError, OSError):
                 if engine == "native":
                     raise
         elif engine == "native":
             raise DMLCError("native engine requested but not built")
+    elif engine == "native" and has_custom_split:
+        raise DMLCError("native engine does not accept split_factory; "
+                        "use engine='python' for injected splits")
     return python_cls(**kwargs)
 
 
@@ -114,16 +124,22 @@ class TextParserBase(Parser):
     def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1,
                  index_dtype=np.uint32, split_type: str = "text",
                  chunk_size: int = 8 << 20, prefetch: bool = True,
+                 prefetch_depth: int = 4, split_factory=None,
                  engine: str = "auto", **_ignored: Any):
         spec = URISpec(uri)
         self.uri = uri
         self.index_dtype = np.dtype(index_dtype)
-        self._split = InputSplit.create(uri, part_index, num_parts,
-                                        split_type, chunk_size=chunk_size)
+        # split_factory (dmlc_tpu.pipeline): inject a custom InputSplit
+        # (e.g. InputSplitShuffle) in place of the default byte-range
+        # split — python engine only (native builds its own reader)
+        self._split = (split_factory() if split_factory is not None
+                       else InputSplit.create(uri, part_index, num_parts,
+                                              split_type,
+                                              chunk_size=chunk_size))
         self._block: Optional[RowBlock] = None
         self._prefetch: Optional[ThreadedIter] = None
         if prefetch and getattr(self._split, "rewindable", True):
-            self._prefetch = ThreadedIter(max_capacity=4)
+            self._prefetch = ThreadedIter(max_capacity=prefetch_depth)
             self._prefetch.init(self._split.next_chunk,
                                 self._split.before_first)
 

@@ -144,6 +144,27 @@ class ThreadedIter(Generic[T]):
     def recycle(self, item: T) -> None:
         """API parity with the reference's buffer recycling (no-op here)."""
 
+    # -- introspection/tuning (dmlc_tpu.pipeline probes + autotuner)
+
+    def qsize(self) -> int:
+        """Items currently buffered (occupancy sample for stage probes)."""
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def set_capacity(self, n: int) -> None:
+        """Resize the bounded queue between epochs (autotune knob). A
+        grow wakes a producer blocked in _emit; a shrink takes effect as
+        the consumer drains below the new bound — queued items are never
+        dropped."""
+        check(n >= 1, "capacity must be >= 1")
+        with self._lock:
+            self._cap = n
+            self._not_full.notify_all()
+
     def before_first(self) -> None:
         """Restart iteration (reference: BeforeFirst)."""
         check(self._thread is not None, "ThreadedIter not initialized")

@@ -342,6 +342,17 @@ class _AlignedSplitBase(InputSplit):
                        self._file_end - self._cur,
                        self._end - self._cur)
             raw = self._stream.read(want) if want > 0 else b""
+            if want > 0 and not raw:
+                # EOF inside the recorded byte range: the backing file
+                # SHRANK after the split captured its sizes. Without
+                # this check the loop would spin forever re-reading 0
+                # bytes (cur never advances to the recorded end).
+                raise DMLCError(
+                    f"InputSplit: unexpected EOF at global offset "
+                    f"{self._cur} ({min(self._file_end, self._end) - self._cur} "
+                    f"bytes short of the recorded range) — the backing "
+                    f"file shrank after the split was created; recreate "
+                    f"the split after mutating inputs")
             self._bytes_read += len(raw)
             self._cur += len(raw)
             at_file_end = self._cur >= min(self._file_end, self._end)

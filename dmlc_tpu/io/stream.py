@@ -78,12 +78,22 @@ class Stream:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    def as_file(self, size: Optional[int] = None) -> "_StreamFile":
+    def as_file(self, size: Optional[int] = None,
+                own_stream: bool = False) -> "_StreamFile":
         """Adapt to a Python binary-file-like object (reference
         dmlc::istream). Pass the object's total ``size`` to enable
         seek-from-end (whence=2) on SeekStreams — consumers like
-        pyarrow discover file size that way."""
-        return _StreamFile(self, size=size)
+        pyarrow discover file size that way.
+
+        Ownership (ADVICE r5): by default the adapter does NOT own the
+        stream — closing the adapter (or letting it be GC'd;
+        RawIOBase.__del__ calls close()) leaves the stream open, so a
+        temporary ``s.as_file().write(...)`` cannot close ``s`` out
+        from under its owner mid-``with``. Pass ``own_stream=True`` to
+        transfer ownership: the adapter then closes the underlying
+        stream with itself (the right mode when the adapter is handed
+        off, e.g. to pyarrow)."""
+        return _StreamFile(self, size=size, own_stream=own_stream)
 
 
 class SeekStream(Stream):
@@ -183,15 +193,20 @@ class FileStream(SeekStream):
 class _StreamFile(_pyio.RawIOBase):
     """Binary file adapter over a Stream (reference dmlc::istream/ostream)."""
 
-    def __init__(self, stream: Stream, size: Optional[int] = None):
+    def __init__(self, stream: Stream, size: Optional[int] = None,
+                 own_stream: bool = False):
         self._s = stream
         self._size = size
+        self._own = own_stream
 
     def close(self) -> None:
-        # propagate to the underlying Stream (fd/socket/remote handle) —
-        # RawIOBase.close() alone would strand it until GC
+        # with own_stream, propagate to the underlying Stream (fd/
+        # socket/remote handle) — RawIOBase.close() alone would strand
+        # it until GC; without it, the stream's owner keeps control
+        # (see Stream.as_file)
         try:
-            self._s.close()
+            if self._own:
+                self._s.close()
         finally:
             super().close()
 

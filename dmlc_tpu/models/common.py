@@ -104,7 +104,10 @@ class SparseModelBase:
             wsum = jax.lax.psum(wsum, axis)
             return _weighted_mean(lsum, wsum)
 
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:  # pre-0.4.35 jax: experimental namespace
+            from jax.experimental.shard_map import shard_map
         # P() is a tree PREFIX covering the whole params dict; batch
         # columns shard on the data axis
         smapped = shard_map(

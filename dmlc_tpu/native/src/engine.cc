@@ -85,6 +85,35 @@ bool parse_f64_slow(const char* b, const char* e, double* out) {
     ++b;
     if (*b == '+' || *b == '-') return false;
   }
+#if !(defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L)
+  // Toolchains without floating-point from_chars (GCC 10 libstdc++):
+  // the frozen contract IS strtod semantics, so call strtod on a
+  // bounded NUL-terminated copy. Reject what strtod tolerates but the
+  // golden (Python float()) rejects: hex floats, nan(...) payloads,
+  // leading whitespace. Overflow/underflow need no fixup — strtod
+  // already returns ±inf / correctly-rounded subnormals.
+  size_t n = (size_t)(e - b);
+  if (n == 0) return false;
+  if ((unsigned char)b[0] <= ' ') return false;
+  for (const char* p = b; p < e; ++p)
+    if (*p == 'x' || *p == 'X' || *p == '(') return false;
+  char stackbuf[128];
+  std::string heapbuf;
+  const char* buf;
+  if (n >= sizeof(stackbuf)) {
+    heapbuf.assign(b, n);
+    buf = heapbuf.c_str();
+  } else {
+    std::memcpy(stackbuf, b, n);
+    stackbuf[n] = '\0';
+    buf = stackbuf;
+  }
+  char* endp = nullptr;
+  double v = std::strtod(buf, &endp);
+  if (endp != buf + n) return false;
+  *out = v;
+  return true;
+#else
   auto r = std::from_chars(b, e, *out);
   if (r.ec == std::errc() && r.ptr == e) return true;
   if (r.ec == std::errc::result_out_of_range && r.ptr == e) {
@@ -125,6 +154,7 @@ bool parse_f64_slow(const char* b, const char* e, double* out) {
     return true;
   }
   return false;
+#endif
 }
 
 // Clinger fast path: a decimal with mantissa ≤ 2^53 and |exp10| ≤ 22 is

@@ -37,7 +37,7 @@ from dmlc_tpu.utils.logging import DMLCError, check
 __all__ = [
     "worker_envs", "ps_envs", "get_role", "init_from_env", "finalize",
     "launch_local", "launch_ssh", "get_ring", "get_tree", "get_link_map",
-    "find_free_port", "main",
+    "find_free_port", "find_free_ports", "main",
 ]
 
 # env contract (reference: slave_envs in tracker.py)
@@ -64,9 +64,28 @@ def _getenv(name: str) -> Optional[str]:
 
 
 def find_free_port(host: str = "127.0.0.1") -> int:
-    with socket.socket() as s:
-        s.bind((host, 0))
-        return s.getsockname()[1]
+    return find_free_ports(1, host)[0]
+
+
+def find_free_ports(n: int, host: str = "127.0.0.1") -> List[int]:
+    """``n`` distinct free ports, chosen while ALL probe sockets are
+    held open (ADVICE r5): closing a probe before the next bind lets the
+    OS hand the same port out twice, making back-to-back single-port
+    probes (jax coordinator + PS root) collide on bind — a rare startup
+    flake. The ports are only guaranteed distinct from each other; as
+    with any probe-then-bind scheme, another process can still grab one
+    in the window before the real bind."""
+    check(n >= 1, "find_free_ports needs n >= 1")
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind((host, 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
 
 
 def worker_envs(coordinator: str, num_workers: int,
@@ -177,11 +196,19 @@ def launch_local(num_workers: int, command: Sequence[str],
     """
     check(num_workers >= 1, "num_workers must be >= 1")
     check(num_servers >= 0, "num_servers must be >= 0")
-    if coordinator is None:
-        coordinator = f"127.0.0.1:{find_free_port()}"
     ps_root: Optional[Tuple[str, int]] = None
-    if num_servers > 0:
-        ps_root = ("127.0.0.1", find_free_port())
+    if coordinator is None and num_servers > 0:
+        # one probe pass holding both sockets: back-to-back single-port
+        # probes could hand the coordinator and the PS root the SAME
+        # port (ADVICE r5)
+        coord_port, ps_port = find_free_ports(2)
+        coordinator = f"127.0.0.1:{coord_port}"
+        ps_root = ("127.0.0.1", ps_port)
+    else:
+        if coordinator is None:
+            coordinator = f"127.0.0.1:{find_free_port()}"
+        if num_servers > 0:
+            ps_root = ("127.0.0.1", find_free_port())
     import time as _time
     procs: List[subprocess.Popen] = []
 
@@ -193,7 +220,6 @@ def launch_local(num_workers: int, command: Sequence[str],
             p.wait()
 
     deadline = _time.monotonic() + timeout if timeout else None
-    codes: List[Optional[int]] = []
     try:
         # spawning sits INSIDE the guard: a Popen failure mid-loop
         # (EAGAIN/ENOMEM — likelier with PS roles multiplying the
@@ -218,9 +244,32 @@ def launch_local(num_workers: int, command: Sequence[str],
                 renv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
                                     num_servers, role, task_id))
                 procs.append(subprocess.Popen(list(command), env=renv))
-        for p in procs:
-            remaining = (deadline - _time.monotonic()) if deadline else None
-            codes.append(p.wait(timeout=remaining))
+        # Poll the whole gang instead of waiting sequentially (ADVICE
+        # r5): with num_servers > 0 and no timeout, a worker that dies
+        # at startup would leave scheduler/server processes (blocked
+        # waiting for the full DMLC world to register) running forever
+        # — launch_local would hang on them instead of reporting the
+        # worker failure. The moment ANY member exits nonzero, kill the
+        # remainder and raise with the codes collected so far.
+        codes = [None] * len(procs)
+        while any(c is None for c in codes):
+            if deadline is not None and _time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired(list(command), timeout)
+            failed = False
+            for i, p in enumerate(procs):
+                if codes[i] is None:
+                    codes[i] = p.poll()
+                    if codes[i] is not None and codes[i] != 0:
+                        failed = True
+            if failed:
+                _kill_gang()
+                codes = [p.returncode if c is None else c
+                         for c, p in zip(codes, procs)]
+                raise DMLCError(
+                    f"worker failure, exit codes {codes} (gang killed "
+                    "on first nonzero exit)")
+            if any(c is None for c in codes):
+                _time.sleep(0.05)
     except subprocess.TimeoutExpired:
         _kill_gang()
         raise DMLCError(

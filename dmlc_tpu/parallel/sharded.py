@@ -31,7 +31,9 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.data.rowblock import RowBlock
-from dmlc_tpu.utils.logging import DMLCError, check, check_eq, check_le
+from dmlc_tpu.utils.logging import (
+    DMLCError, check, check_eq, check_le, log_warning,
+)
 
 __all__ = ["pad_to_bucket", "stack_device_batches", "make_global_batch",
            "ShardedRowBlockIter", "next_pow2_bucket", "empty_block",
@@ -207,11 +209,20 @@ class ShardedRowBlockIter:
         self.replay_epochs = 0  # served-from-memory epochs (stats/tests)
         self._round_cache: Optional[List[Dict[str, np.ndarray]]] = None
         self._fingerprint = None
+        # serve-side prefetch lookahead (rounds assembled ahead of the
+        # consumer); dmlc_tpu.pipeline exposes it as an autotuner knob
+        self.prefetch_depth = 2
         # optional-key schema (qid/field), observed locally and OR-agreed
         # across processes so every rank pads exhausted parts to the SAME
         # key set (ADVICE r4)
         self._has_qid = False
         self._has_field = False
+        # ADVICE r5: a qid/field column that first appears MID-file flips
+        # the batch key set at the discovery round — consumers then see
+        # jit recompiles / key mismatches with no signal. Warn ONCE, the
+        # moment the flip happens after round 0.
+        self._schema_rounds = 0
+        self._schema_warned = False
         self._rounds_per_epoch: Optional[int] = None
         # per-part block counts from epoch 1: later epochs assert the
         # replay produced exactly these (file-mutation detector)
@@ -233,6 +244,15 @@ class ShardedRowBlockIter:
             Parser.create(uri, p, total_parts, format=format,
                           index_dtype=index_dtype, **parser_kwargs)
             for p in self._my_parts]
+        # (path, size) at construction: steady epochs stat-check these
+        # BEFORE touching any reader — a shrunk file under the native
+        # engine's mmap views is SIGBUS (uncatchable), so the shrink
+        # must be detected by stat, not by reading
+        try:
+            from dmlc_tpu.io.input_split import list_split_files
+            self._ctor_sizes = list_split_files(uri)
+        except Exception:  # noqa: BLE001 — non-stat-able backing
+            self._ctor_sizes = None
 
     def _first_epoch_batches(self) -> Iterator[Dict[str, jax.Array]]:
         """Epoch 1: agree on rounds-per-epoch across processes.
@@ -395,7 +415,7 @@ class ShardedRowBlockIter:
         assembly/transfer of round r+1 overlaps the consumer's work on
         round r."""
         from dmlc_tpu.data.threaded_iter import ThreadedIter
-        ti = ThreadedIter(max_capacity=2)
+        ti = ThreadedIter(max_capacity=self.prefetch_depth)
         ti.init(make_next)
         try:
             while (batch := ti.next()) is not None:
@@ -461,6 +481,56 @@ class ShardedRowBlockIter:
             "replay is the contract; recreate the iterator after "
             "mutating inputs)")
 
+    def _note_schema(self, has_qid: bool, has_field: bool) -> None:
+        """OR newly observed optional keys into the schema, warning ONCE
+        if a key first appears after the first assembled round (ADVICE
+        r5): from that round on the per-batch key set differs from the
+        earlier rounds' (and from replay/re-parse epochs, which carry
+        the keys from round 0) — consumers see jit recompiles or key
+        mismatches. The fix is uniform columns: tag every row (qid) /
+        every feature (field), or none."""
+        if self._schema_rounds > 0 and not self._schema_warned:
+            flipped = [name for name, seen, new in (
+                ("qid", self._has_qid, has_qid),
+                ("field", self._has_field, has_field)) if new and not seen]
+            if flipped:
+                self._schema_warned = True
+                log_warning(
+                    f"ShardedRowBlockIter: optional column(s) "
+                    f"{'/'.join(flipped)} first appeared after "
+                    f"{self._schema_rounds} assembled round(s) — the "
+                    "batch key set changes at this round and will differ "
+                    "from earlier rounds and from replay/re-parse epochs "
+                    "(expect jit recompiles / pytree-structure "
+                    "mismatches). Supply uniform columns: tag every row "
+                    "(qid) / every feature (field), or none.")
+        self._has_qid |= has_qid
+        self._has_field |= has_field
+
+    def _check_not_shrunk(self) -> None:
+        """Raise the mutation error if any backing file SHRANK since
+        construction. Shrinkage is conclusive mutation evidence, and it
+        must be caught by stat BEFORE a re-parse: the native engine
+        reads files through mmap views, and touching pages past a new
+        EOF is SIGBUS — a crash, not a catchable error (append and
+        same-size rewrite still go to the read-path detectors)."""
+        if self._ctor_sizes is None:
+            return
+        import os
+        from dmlc_tpu.io.tpu_fs import local_path
+        for path, size in self._ctor_sizes:
+            try:
+                now = os.stat(local_path(path)).st_size
+            except OSError:
+                continue  # deleted/unstatable: the read path reports it
+            if now < size:
+                raise DMLCError(
+                    f"ShardedRowBlockIter: backing file {path} shrank "
+                    f"from {size} to {now} bytes — the underlying file "
+                    "changed between epochs of one iterator "
+                    "(deterministic replay is the contract; recreate "
+                    "the iterator after mutating inputs)")
+
     def _restart_streams(self):
         its = []
         for p in self._parsers:
@@ -477,12 +547,13 @@ class ShardedRowBlockIter:
             try:
                 blk = next(it)
                 counts[i] += 1
-                self._has_qid |= blk.qid is not None
-                self._has_field |= blk.field is not None
+                self._note_schema(blk.qid is not None,
+                                  blk.field is not None)
                 row.append(blk)
             except StopIteration:
                 done[i] = True
                 row.append(empty_block(self.index_dtype))
+        self._schema_rounds += 1
         return row
 
     def _try_cache_epoch(self) -> Optional[List[List[Dict[str, np.ndarray]]]]:
@@ -505,8 +576,8 @@ class ShardedRowBlockIter:
             p.before_first()
             part: List[Dict[str, np.ndarray]] = []
             for blk in self._rechunk(p):
-                self._has_qid |= blk.qid is not None
-                self._has_field |= blk.field is not None
+                self._note_schema(blk.qid is not None,
+                                  blk.field is not None)
                 padded = pad_to_bucket(blk, self.row_bucket,
                                        self.nnz_bucket)
                 used += sum(int(v.nbytes) for v in padded.values())
@@ -551,8 +622,11 @@ class ShardedRowBlockIter:
                       int(self._has_qid), int(self._has_field)],
                      dtype=np.int64))
         data = data.reshape(-1, 4)
-        self._has_qid = bool(np.any(data[:, 2]))
-        self._has_field = bool(np.any(data[:, 3]))
+        # collective OR bypasses _note_schema's flip warning: a peer
+        # rank's keys arriving via agreement BEFORE this rank yields a
+        # batch is the protocol working, not a mid-file flip
+        self._has_qid |= bool(np.any(data[:, 2]))
+        self._has_field |= bool(np.any(data[:, 3]))
         return bool(np.all(data[:, 0] == 1)), int(np.max(data[:, 1]))
 
     def _all_processes_done(self, local_done: bool) -> bool:
@@ -569,8 +643,11 @@ class ShardedRowBlockIter:
             np.array([local_done, self._has_qid, self._has_field],
                      dtype=np.bool_))
         flags = flags.reshape(-1, 3)
-        self._has_qid = bool(np.any(flags[:, 1]))
-        self._has_field = bool(np.any(flags[:, 2]))
+        # collective OR: no flip warning (see _agree_first_epoch) — the
+        # per-round agreement delivers peer keys before this round's
+        # assembly, so batches stay uniformly keyed
+        self._has_qid |= bool(np.any(flags[:, 1]))
+        self._has_field |= bool(np.any(flags[:, 2]))
         return bool(np.all(flags[:, 0]))
 
     def _rechunk(self, parser) -> Iterator[RowBlock]:
@@ -594,11 +671,11 @@ class ShardedRowBlockIter:
         # is an empty pad must still carry the keys earlier rounds did.
         # (Degenerate sources where qid/field first appears MID-file
         # change the batch structure at the discovery round in epoch 1,
-        # and epochs 2+ carry the discovered keys from round 0 — supply
-        # uniform columns for structure-stable batches; real ranking/FFM
-        # corpora tag every row.)
-        self._has_qid |= any(b.qid is not None for b in blocks)
-        self._has_field |= any(b.field is not None for b in blocks)
+        # and epochs 2+ carry the discovered keys from round 0 —
+        # _note_schema logs the hazard once; real ranking/FFM corpora
+        # tag every row.)
+        self._note_schema(any(b.qid is not None for b in blocks),
+                          any(b.field is not None for b in blocks))
         return stack_device_batches(
             [ensure_schema(pad_to_bucket(b, rb, nb), rb, nb,
                            self._has_qid, self._has_field)
@@ -612,6 +689,7 @@ class ShardedRowBlockIter:
         if self._rounds_per_epoch is None:
             yield from self._first_epoch_batches()
             return
+        self._check_not_shrunk()
         if self._round_cache is not None:
             if (self._fingerprint is not None
                     and self._fingerprint == self._fingerprint_now()):

@@ -1,0 +1,22 @@
+"""dmlc_tpu.pipeline — declarative dataset-pipeline graphs.
+
+The composition layer over the IO/data/parallel machinery: a tf.data-
+style chain (``Pipeline.from_uri(...).parse(...).prefetch().to_device()``)
+that compiles down to InputSplit / Parser / ThreadedIter / DiskRowIter /
+ShardedRowBlockIter, with a telemetry probe at every stage boundary
+(``dmlc_tpu.pipeline.stats``) and a between-epoch autotuner over queue
+depths (``dmlc_tpu.pipeline.autotune``). See docs/pipeline.md.
+"""
+
+from dmlc_tpu.pipeline.autotune import Autotuner, Knob
+from dmlc_tpu.pipeline.graph import CompiledPipeline, Pipeline
+from dmlc_tpu.pipeline.stages import StageSpec
+from dmlc_tpu.pipeline.stats import (
+    PIPELINE_STATS_SCHEMA, StageProbe, snapshot,
+)
+
+__all__ = [
+    "Pipeline", "CompiledPipeline", "StageSpec",
+    "Autotuner", "Knob",
+    "StageProbe", "snapshot", "PIPELINE_STATS_SCHEMA",
+]

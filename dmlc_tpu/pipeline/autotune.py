@@ -1,0 +1,200 @@
+"""Between-epoch depth autotuning from stage telemetry.
+
+Replaces the hard-coded queue depths the hand-wired stacks carried
+(``ThreadedIter(max_capacity=4)``, the bench loop's fixed ``> 4``
+in-flight device window, ``depth(chunkq=3, reorder=2)`` in BENCH logs)
+with measured decisions: after every completed epoch the tuner reads the
+pipeline's stats snapshot (``dmlc_tpu.pipeline.stats``) and adjusts at
+most ONE knob, then watches the next epoch's throughput to keep or
+revert the change.
+
+Model (deliberately simple — one trial per epoch keeps every decision
+attributable):
+
+- A queue whose mean occupancy is near its capacity is *producer-ahead*:
+  the producer fills it and blocks. Growing it lets the producer run
+  further ahead and absorbs consumer bursts → trial ``depth *= 2``.
+- A queue that is near-empty while its consumer still waits on it is
+  *producer-bound*: depth cannot help; a near-empty queue with NO
+  consumer wait is over-provisioned → trial ``depth //= 2`` (memory
+  thrift).
+- A windowed transfer stage (``to_device``) whose transfer-drain wait
+  dominates grows its in-flight window.
+- Any trial whose next-epoch throughput drops below
+  ``revert_tolerance`` × the best accepted throughput is reverted and
+  the knob is frozen for ``cooldown`` epochs.
+
+Convergence: knob values are clamped to [lo, hi] and every accept/revert
+is recorded in ``report()`` — on a steady workload the tuner reaches a
+fixed point (tests/test_pipeline.py pins this on a synthetic slow
+stage).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from dmlc_tpu.utils.logging import check
+
+__all__ = ["Knob", "Autotuner"]
+
+
+class Knob:
+    """One tunable integer depth bound to a live pipeline object."""
+
+    __slots__ = ("name", "stage", "get", "set", "lo", "hi", "initial",
+                 "frozen_until")
+
+    def __init__(self, name: str, stage: str, get: Callable[[], int],
+                 set: Callable[[int], None], lo: int, hi: int):
+        check(lo >= 1 and hi >= lo, f"knob {name}: bad bounds [{lo},{hi}]")
+        self.name = name
+        self.stage = stage  # probe name whose telemetry drives this knob
+        self.get = get
+        self.set = set
+        self.lo = lo
+        self.hi = hi
+        self.initial = get()
+        self.frozen_until = 0  # epoch index gate after a revert
+
+
+class Autotuner:
+    """One-trial-per-epoch hill climber over pipeline depth knobs."""
+
+    def __init__(self, knobs: List[Knob], *,
+                 grow_occupancy: float = 0.7,
+                 shrink_occupancy: float = 0.15,
+                 wait_frac_floor: float = 0.05,
+                 revert_tolerance: float = 0.9,
+                 cooldown: int = 3):
+        self.knobs = list(knobs)
+        self.grow_occupancy = grow_occupancy
+        self.shrink_occupancy = shrink_occupancy
+        self.wait_frac_floor = wait_frac_floor
+        self.revert_tolerance = revert_tolerance
+        self.cooldown = cooldown
+        self._epoch = 0
+        self._best_tp: Optional[float] = None
+        self._pending: Optional[Dict[str, Any]] = None
+        self._log: List[Dict[str, Any]] = []
+
+    # -- helpers
+
+    @staticmethod
+    def _throughput(snapshot: Dict[str, Any]) -> float:
+        """Epoch objective: sink-stage bytes/s (falls back to items/s
+        ×1.0 when the sink reports no bytes — same ordering either
+        way)."""
+        wall = snapshot.get("wall_s") or 0.0
+        if wall <= 0:
+            return 0.0
+        stages = snapshot.get("stages") or []
+        if not stages:
+            return 0.0
+        sink = stages[-1]
+        vol = sink.get("bytes") or sink.get("items") or 0
+        return vol / wall
+
+    @staticmethod
+    def _stage(snapshot: Dict[str, Any], name: str) -> Optional[Dict]:
+        for s in snapshot.get("stages", []):
+            if s.get("name") == name:
+                return s
+        return None
+
+    def _resolve_pending(self, tp: float) -> None:
+        trial = self._pending
+        self._pending = None
+        assert trial is not None
+        knob = trial["knob"]
+        if (self._best_tp is not None
+                and tp < self.revert_tolerance * self._best_tp):
+            knob.set(trial["old"])
+            knob.frozen_until = self._epoch + self.cooldown
+            trial["outcome"] = "reverted"
+        else:
+            trial["outcome"] = "accepted"
+            if self._best_tp is None or tp > self._best_tp:
+                self._best_tp = tp
+        trial["throughput"] = round(tp, 2)
+        self._log.append({k: v for k, v in trial.items() if k != "knob"})
+
+    def _propose(self, snapshot: Dict[str, Any]) -> None:
+        for knob in self.knobs:
+            if self._epoch < knob.frozen_until:
+                continue
+            stage = self._stage(snapshot, knob.stage)
+            if stage is None:
+                continue
+            cur = knob.get()
+            new = None
+            reason = None
+            occ = stage.get("queue_occupancy")
+            if occ is not None:
+                if occ >= self.grow_occupancy and cur < knob.hi:
+                    new = min(cur * 2, knob.hi)
+                    reason = f"occupancy {occ:.2f} ≥ {self.grow_occupancy}"
+                elif (occ <= self.shrink_occupancy and cur > knob.lo
+                      and (stage.get("wait_frac") or 0.0)
+                      <= self.wait_frac_floor):
+                    new = max(cur // 2, knob.lo)
+                    reason = (f"occupancy {occ:.2f} ≤ "
+                              f"{self.shrink_occupancy}, idle consumer")
+            else:
+                # windowed stage (to_device): grow while its drain wait
+                # dominates the epoch
+                extra = stage.get("extra") or {}
+                xfer = extra.get("xfer_wait_s")
+                wall = snapshot.get("wall_s") or 0.0
+                if (xfer is not None and wall > 0
+                        and xfer / wall > self.wait_frac_floor
+                        and cur < knob.hi):
+                    new = min(cur * 2, knob.hi)
+                    reason = f"xfer wait {xfer / wall:.2f} of epoch"
+            if new is not None and new != cur:
+                knob.set(new)
+                self._pending = {"knob": knob, "name": knob.name,
+                                 "epoch": self._epoch, "old": cur,
+                                 "new": new, "reason": reason}
+                return  # one trial per epoch
+
+    # -- public API
+
+    def after_epoch(self, snapshot: Dict[str, Any]) -> None:
+        """Feed one completed epoch's stats; may adjust one knob."""
+        tp = self._throughput(snapshot)
+        if self._pending is not None:
+            self._resolve_pending(tp)
+        elif self._best_tp is None or tp > self._best_tp:
+            self._best_tp = tp
+        self._propose(snapshot)
+        self._epoch += 1
+
+    def values(self) -> Dict[str, int]:
+        return {k.name: k.get() for k in self.knobs}
+
+    def tuned(self) -> Dict[str, int]:
+        """Knobs whose current value differs from their initial one —
+        the 'set by the autotuner rather than a constant' evidence."""
+        return {k.name: k.get() for k in self.knobs
+                if k.get() != k.initial}
+
+    def converged(self, last_n: int = 3) -> bool:
+        """No accepted change in the last ``last_n`` decisions (or no
+        decisions at all and no trial pending)."""
+        if self._pending is not None:
+            return False
+        recent = self._log[-last_n:]
+        return all(d["outcome"] != "accepted" for d in recent) \
+            if recent else self._epoch >= last_n
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            "epochs": self._epoch,
+            "values": self.values(),
+            "initial": {k.name: k.initial for k in self.knobs},
+            "tuned": self.tuned(),
+            "decisions": list(self._log),
+            "best_throughput": (round(self._best_tp, 2)
+                                if self._best_tp is not None else None),
+        }

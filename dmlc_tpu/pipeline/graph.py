@@ -1,0 +1,723 @@
+"""Declarative dataset-pipeline graph → compiled, probed, tunable runs.
+
+``Pipeline`` is the tf.data-style composition layer over the existing
+machinery: chaining builds an immutable stage-spec tuple
+(``dmlc_tpu.pipeline.stages``), ``build()`` lowers it onto
+InputSplit / Parser / ThreadedIter / DiskRowIter / ShardedRowBlockIter —
+nothing is reimplemented. Every stage boundary carries a
+:class:`~dmlc_tpu.pipeline.stats.StageProbe` (wait time, rows/bytes,
+queue occupancy) and every ``"auto"`` depth becomes an
+:class:`~dmlc_tpu.pipeline.autotune.Knob` the between-epoch
+:class:`~dmlc_tpu.pipeline.autotune.Autotuner` adjusts.
+
+    pipe = (Pipeline.from_uri("train.libsvm", part_index=0, num_parts=1)
+            .parse(format="libsvm")
+            .batch(16384)
+            .prefetch(depth="auto")
+            .to_device(window="auto"))
+    built = pipe.build(autotune=True)
+    for epoch in range(epochs):
+        for device_batch in built:          # one epoch
+            step(device_batch)
+        print(built.stats())                # per-stage snapshot
+    built.close()
+
+Ownership contract (the RowBlock lifetime rules, composed once here so
+every stage agrees): a stage yields items valid until the consumer's
+next pull. Buffering stages (``prefetch``) take ownership of ephemeral
+native-engine blocks by detaching their arena lease (falling back to a
+copy); ``to_device`` holds the lease until the async transfer lands —
+the exact discipline bench.py hand-wired.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from dmlc_tpu.pipeline.autotune import Autotuner, Knob
+from dmlc_tpu.pipeline.stages import StageSpec, validate_chain
+from dmlc_tpu.pipeline.stats import StageProbe, snapshot
+from dmlc_tpu.utils.logging import DMLCError, check
+
+__all__ = ["Pipeline", "CompiledPipeline"]
+
+_END = object()
+
+
+def _probed(runner) -> Iterator:
+    """Pull a runner's epoch through its probe: every boundary crossing
+    records wait time, volume, and (when queue-backed) occupancy."""
+    gen = runner.epoch()
+    probe = runner.probe
+    while True:
+        t0 = time.perf_counter()
+        item = next(gen, _END)
+        dt = time.perf_counter() - t0
+        if item is _END:
+            probe.record_wait_only(dt)
+            return
+        probe.record(item, dt, runner.queue)
+        yield item
+
+
+class _RunnerBase:
+    """One lowered stage: re-enterable epochs + probe + optional knobs."""
+
+    kind = "?"
+    owned = True          # items survive past the consumer's next pull
+    up: Optional["_RunnerBase"] = None
+
+    def __init__(self, name: str):
+        self.probe = StageProbe(name, self.kind)
+
+    @property
+    def queue(self):
+        """Live bounded queue for occupancy sampling, or None."""
+        return None
+
+    def epoch(self) -> Iterator:
+        raise NotImplementedError
+
+    def detach_last(self):
+        """Take ownership of the last yielded item's arena lease
+        (native engine); None when items are already owned."""
+        return None
+
+    def knobs(self) -> List[Knob]:
+        return []
+
+    def finalize_epoch(self) -> None:
+        """Stage-specific snapshot extras (engine stats, drain waits)."""
+
+    def close(self) -> None:
+        pass
+
+
+class _ParseRunner(_RunnerBase):
+    """source [+ shuffle] + parse → Parser.create (native or python)."""
+
+    kind = "parse"
+
+    def __init__(self, source: StageSpec, shuffle: Optional[StageSpec],
+                 parse: StageSpec):
+        super().__init__("parse")
+        sp = source.params
+        p = dict(parse.params)
+        fmt = p.pop("format", None)
+        depth = p.pop("prefetch_depth", "auto")
+        self._auto_depth = depth == "auto"
+        kwargs = {k: v for k, v in p.items() if v is not None}
+        if shuffle is not None:
+            # chunk-level shuffled read order lowers to InputSplitShuffle
+            # injected under the python engine (the native reader owns
+            # its own split)
+            from dmlc_tpu.io.input_split_shuffle import InputSplitShuffle
+            kwargs["engine"] = "python"
+            chunk = kwargs.get("chunk_size", 8 << 20)
+            shp = shuffle.params
+
+            def factory():
+                return InputSplitShuffle.create(
+                    sp["uri"], sp["part_index"], sp["num_parts"],
+                    sp["split_type"],
+                    num_shuffle_parts=shp["num_shuffle_parts"],
+                    seed=shp["seed"], chunk_size=chunk)
+
+            kwargs["split_factory"] = factory
+        if sp["split_type"] != "text":
+            # non-default record framing reaches TextParserBase; the
+            # native engine (text reader only) declines it and "auto"
+            # falls back to the python golden
+            kwargs["split_type"] = sp["split_type"]
+        from dmlc_tpu.data.parser import Parser
+        self._parser = Parser.create(
+            sp["uri"], sp["part_index"], sp["num_parts"], format=fmt,
+            prefetch_depth=4 if self._auto_depth else int(depth), **kwargs)
+        self.owned = not hasattr(self._parser, "detach")
+        if shuffle is not None:
+            # formats whose parser ignores split_factory (parquet's
+            # param struct swallows unknown keys) would silently yield
+            # UNshuffled data — refuse instead
+            from dmlc_tpu.io.input_split_shuffle import InputSplitShuffle
+            split = getattr(self._parser, "_split", None)
+            if (shuffle.params["num_shuffle_parts"] > 1
+                    and not isinstance(split, InputSplitShuffle)):
+                raise DMLCError(
+                    f"pipeline: shuffle is not supported by the "
+                    f"{fmt or 'default'} parser (it ignores the "
+                    "injected split); shuffle works with record-stream "
+                    "formats (libsvm/csv/libfm)")
+
+    @property
+    def queue(self):
+        return getattr(self._parser, "_prefetch", None)
+
+    def epoch(self) -> Iterator:
+        p = self._parser
+        p.before_first()
+        while p.next():
+            yield p.value()
+
+    def detach_last(self):
+        detach = getattr(self._parser, "detach", None)
+        return detach() if detach is not None else None
+
+    def knobs(self) -> List[Knob]:
+        ti = self.queue
+        if self._auto_depth and ti is not None:
+            return [Knob("parse.chunk_prefetch", "parse",
+                         lambda: ti.capacity, ti.set_capacity,
+                         lo=1, hi=32)]
+        return []
+
+    def finalize_epoch(self) -> None:
+        stats_fn = getattr(self._parser, "stats", None)
+        if stats_fn is not None:
+            try:
+                self.probe.extra["engine"] = stats_fn()
+            except Exception:  # noqa: BLE001 — telemetry must not kill
+                pass
+        try:
+            self.probe.extra["bytes_read"] = int(self._parser.bytes_read())
+        except Exception:  # noqa: BLE001
+            pass
+
+    def close(self) -> None:
+        if hasattr(self._parser, "destroy"):
+            self._parser.destroy()
+
+
+class _CacheRunner(_RunnerBase):
+    """parse + cache → DiskRowIter binary row pages (parse once at
+    build, replay pages every epoch)."""
+
+    kind = "cache"
+
+    def __init__(self, source: StageSpec, shuffle: Optional[StageSpec],
+                 parse: StageSpec, cache: StageSpec):
+        super().__init__("cache")
+        check(shuffle is None,
+              "pipeline: shuffle + cache is not lowerable (the page "
+              "cache replays one fixed order); shuffle after cache via "
+              "a map stage, or drop the cache")
+        from dmlc_tpu.data.row_iter import DiskRowIter
+        sp = source.params
+        p = {k: v for k, v in parse.params.items() if v is not None}
+        p.pop("prefetch_depth", None)
+        fmt = p.pop("format", None)
+        if sp["split_type"] != "text":
+            p["split_type"] = sp["split_type"]
+
+        def make_parser():
+            from dmlc_tpu.data.parser import Parser
+            return Parser.create(sp["uri"], sp["part_index"],
+                                 sp["num_parts"], format=fmt, **p)
+
+        self._it = DiskRowIter(make_parser, cache.params["path"],
+                               rows_per_page=cache.params["rows_per_page"])
+
+    @property
+    def queue(self):
+        return getattr(self._it, "_iter", None)
+
+    def epoch(self) -> Iterator:
+        it = self._it
+        it.before_first()
+        while it.next():
+            yield it.value()
+
+    def close(self) -> None:
+        self._it._close()
+
+
+class _ShardRunner(_RunnerBase):
+    """source [+ parse opts] + shard → ShardedRowBlockIter global
+    batches ([D, ...] jax.Arrays on the mesh's data axis)."""
+
+    kind = "shard"
+
+    def __init__(self, source: StageSpec, parse: Optional[StageSpec],
+                 shard: StageSpec):
+        super().__init__("shard")
+        from dmlc_tpu.parallel.sharded import ShardedRowBlockIter
+        sp = source.params
+        p = dict(parse.params) if parse is not None else {}
+        p.pop("prefetch_depth", None)
+        fmt = p.pop("format", None)
+        p = {k: v for k, v in p.items() if v is not None}
+        if sp["split_type"] != "text":
+            p["split_type"] = sp["split_type"]
+        shp = dict(shard.params)
+        mesh = shp.pop("mesh")
+        self._it = ShardedRowBlockIter(sp["uri"], mesh, format=fmt,
+                                       **shp, **p)
+
+    def epoch(self) -> Iterator:
+        return iter(self._it)
+
+    def knobs(self) -> List[Knob]:
+        it = self._it
+
+        def _set(n: int) -> None:
+            it.prefetch_depth = n
+
+        return [Knob("shard.prefetch", "shard",
+                     lambda: it.prefetch_depth, _set, lo=1, hi=8)]
+
+
+class _BatchRunner(_RunnerBase):
+    """Re-chunk the block stream to fixed row counts (owned output)."""
+
+    kind = "batch"
+
+    def __init__(self, up: _RunnerBase, rows: int, drop_remainder: bool):
+        super().__init__("batch")
+        check(rows >= 1, "batch(rows) needs rows >= 1")
+        self.up = up
+        self._rows = rows
+        self._drop = drop_remainder
+
+    def epoch(self) -> Iterator:
+        from dmlc_tpu.data.rowblock import RowBlockContainer
+        pending: Optional[RowBlockContainer] = None
+        for block in _probed(self.up):
+            if pending is None:
+                pending = RowBlockContainer(block.index.dtype)
+            start = 0
+            while start < block.size:
+                take = min(block.size - start, self._rows - pending.size)
+                pending.push_block(block.slice(start, start + take))
+                start += take
+                if pending.size >= self._rows:
+                    yield pending.get_block()
+                    pending = RowBlockContainer(block.index.dtype)
+        if pending is not None and pending.size and not self._drop:
+            yield pending.get_block()
+
+
+class _MapRunner(_RunnerBase):
+    """User fn over each item. The fn sees the upstream item under the
+    upstream's lifetime contract; ownership passes through unchanged."""
+
+    kind = "map"
+
+    def __init__(self, up: _RunnerBase, fn: Callable, name: str):
+        super().__init__(name)
+        self.up = up
+        self._fn = fn
+        self.owned = up.owned  # lifetime contract passes through
+
+    def epoch(self) -> Iterator:
+        fn = self._fn
+        for item in _probed(self.up):
+            yield fn(item)
+
+    def detach_last(self):
+        return self.up.detach_last()
+
+
+class _PrefetchRunner(_RunnerBase):
+    """Bounded background queue (ThreadedIter). Converts ephemeral
+    upstream items to owned ones: the producer thread detaches each
+    native arena lease (or copies), and the consumer releases a lease
+    when the NEXT item is pulled — preserving the valid-until-next-pull
+    contract downstream."""
+
+    kind = "prefetch"
+
+    def __init__(self, up: _RunnerBase, depth):
+        super().__init__("prefetch")
+        self.up = up
+        self._auto = depth == "auto"
+        from dmlc_tpu.data.threaded_iter import ThreadedIter
+        self._ti = ThreadedIter(
+            max_capacity=4 if self._auto else int(depth))
+        self._src: Optional[Iterator] = None
+        self._started = False
+
+    @property
+    def queue(self):
+        return self._ti
+
+    def _restart(self) -> None:
+        gen = _probed(self.up)
+        if self.up.owned:
+            self._src = gen
+            return
+
+        def owning():
+            for item in gen:
+                lease = self.up.detach_last()
+                if lease is not None:
+                    item.lease = lease
+                else:
+                    item = item.copy()
+                yield item
+
+        self._src = owning()
+
+    def epoch(self) -> Iterator:
+        if not self._started:
+            self._restart()
+            self._ti.init(lambda: next(self._src, None), self._restart)
+            self._started = True
+        else:
+            self._ti.before_first()
+        prev = None
+
+        def release_prev():
+            if prev is not None and getattr(prev, "lease", None) is not None:
+                prev.lease.release()
+                prev.lease = None
+
+        try:
+            while True:
+                item = self._ti.next()
+                release_prev()
+                if item is None:
+                    return
+                prev = item  # before the yield: an abandoned epoch's
+                yield item   # finally must release the CURRENT item too
+        finally:
+            release_prev()  # the epoch's last lease (or an abandon)
+
+    def knobs(self) -> List[Knob]:
+        if not self._auto:
+            return []
+        return [Knob("prefetch.depth", "prefetch",
+                     lambda: self._ti.capacity, self._ti.set_capacity,
+                     lo=1, hi=64)]
+
+    def close(self) -> None:
+        self._ti.destroy()
+
+
+class _DeviceRunner(_RunnerBase):
+    """Async host→device transfers with a bounded in-flight window —
+    the parse-to-HBM discipline bench.py hand-wired: device_put is
+    enqueued immediately, the arena lease (native engine) is held until
+    that transfer is drained, and ``window`` transfers ride under the
+    upstream's work."""
+
+    kind = "to_device"
+
+    def __init__(self, up: _RunnerBase, device, sharding, window):
+        super().__init__("to_device")
+        self.up = up
+        self._auto = window == "auto"
+        self.window = 4 if self._auto else int(window)
+        check(self.window >= 1, "to_device(window) needs window >= 1")
+        check(device is None or sharding is None,
+              "to_device: pass device OR sharding, not both")
+        self._target = sharding if sharding is not None else device
+
+    @staticmethod
+    def _host_arrays(item) -> Dict[str, np.ndarray]:
+        if isinstance(item, dict):
+            return item
+        out = {"offset": item.offset, "label": item.label,
+               "index": item.index}
+        for k in ("value", "weight", "qid", "field"):
+            v = getattr(item, k)
+            if v is not None:
+                out[k] = v
+        return out
+
+    def _platform(self) -> str:
+        import jax
+        t = self._target
+        if t is None:
+            return jax.default_backend()
+        if hasattr(t, "platform"):       # a Device
+            return t.platform
+        devs = getattr(t, "device_set", None)  # a Sharding
+        if devs:
+            return next(iter(devs)).platform
+        return jax.default_backend()
+
+    def epoch(self) -> Iterator:
+        import jax
+        target = self._target
+        put = (jax.device_put if target is None
+               else (lambda x: jax.device_put(x, target)))
+        cpu_backend = self._platform() == "cpu"
+        in_flight: deque = deque()
+        xfer_wait = 0.0
+
+        def drain_one():
+            nonlocal xfer_wait
+            fut, lease = in_flight.popleft()
+            t0 = time.perf_counter()
+            jax.block_until_ready(fut)
+            xfer_wait += time.perf_counter() - t0
+            self.probe.extra["xfer_wait_s"] = round(xfer_wait, 6)
+            if lease is not None:
+                lease.release()
+            return fut
+
+        for item in _probed(self.up):
+            if self.up.owned:
+                # an OWNED item may still carry a detached arena lease
+                # (prefetch over a native parse): take it over so the
+                # upstream's release-on-next-pull cannot return the
+                # arena while this async transfer is in flight
+                lease = getattr(item, "lease", None)
+                if lease is not None:
+                    item.lease = None
+            else:
+                lease = self.up.detach_last()
+            arrs = self._host_arrays(item)
+            if lease is not None and cpu_backend:
+                # the CPU-aliasing rule (io/tpu_fs._device_put_safe):
+                # CPU-backend device_put may ALIAS host memory, and a
+                # leased arena gets recycled after release — copy now
+                # and free the arena immediately. Real accelerator
+                # transfers copy, keeping the zero-copy fast path.
+                arrs = {k: np.array(v, copy=True) for k, v in arrs.items()}
+                lease.release()
+                lease = None
+            fut = put(arrs)
+            in_flight.append((fut, lease))
+            # window is re-read each round: the autotuner adjusts it
+            # between epochs (and a mid-epoch change is simply honored)
+            while len(in_flight) > self.window:
+                yield drain_one()
+        while in_flight:
+            yield drain_one()
+
+    def knobs(self) -> List[Knob]:
+        if not self._auto:
+            return []
+
+        def _set(n: int) -> None:
+            self.window = n
+
+        return [Knob("device.window", "to_device",
+                     lambda: self.window, _set, lo=1, hi=32)]
+
+
+class CompiledPipeline:
+    """Executable form of a Pipeline: iterate for one epoch, read
+    ``stats()``, let the bound autotuner retune depths between epochs."""
+
+    def __init__(self, runners: List[_RunnerBase],
+                 autotuner: Optional[Autotuner]):
+        self._runners = runners
+        self.autotuner = autotuner
+        self._epoch = 0
+        self._last: Optional[Dict[str, Any]] = None
+
+    # -- iteration
+
+    def __iter__(self) -> Iterator:
+        """One epoch. At a COMPLETE epoch the stats snapshot is frozen
+        and the autotuner (if bound) takes its between-epoch step; an
+        abandoned epoch leaves the previous snapshot in place."""
+        for r in self._runners:
+            r.probe.reset()
+        t0 = time.perf_counter()
+        yield from _probed(self._runners[-1])
+        wall = time.perf_counter() - t0
+        for r in self._runners:
+            r.finalize_epoch()
+        self._epoch += 1
+        self._last = snapshot([r.probe for r in self._runners], wall,
+                              self._epoch, self.knob_values())
+        if self.autotuner is not None:
+            self.autotuner.after_epoch(self._last)
+
+    def run_epoch(self) -> Dict[str, Any]:
+        """Drain one epoch and return its stats snapshot."""
+        for _ in self:
+            pass
+        assert self._last is not None
+        return self._last
+
+    # -- telemetry / tuning
+
+    def stats(self) -> Optional[Dict[str, Any]]:
+        """Snapshot of the last COMPLETE epoch (None before the first)."""
+        return self._last
+
+    def knobs(self) -> List[Knob]:
+        return [k for r in self._runners for k in r.knobs()]
+
+    def knob_values(self) -> Dict[str, int]:
+        return {k.name: k.get() for k in self.knobs()}
+
+    def autotune_report(self) -> Optional[Dict[str, Any]]:
+        return (self.autotuner.report()
+                if self.autotuner is not None else None)
+
+    @property
+    def epochs(self) -> int:
+        return self._epoch
+
+    def close(self) -> None:
+        for r in reversed(self._runners):
+            r.close()
+
+    def __enter__(self) -> "CompiledPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class Pipeline:
+    """Immutable declarative stage chain; see the module docstring."""
+
+    __slots__ = ("_stages",)
+
+    def __init__(self, stages: Tuple[StageSpec, ...]):
+        self._stages = stages
+
+    # -- construction
+
+    @staticmethod
+    def from_uri(uri: str, part_index: int = 0, num_parts: int = 1,
+                 split_type: str = "text") -> "Pipeline":
+        """Root of every pipeline: one shard of a (multi-file) URI —
+        the InputSplit sharding contract."""
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts}")
+        return Pipeline((StageSpec("source", uri=uri,
+                                   part_index=part_index,
+                                   num_parts=num_parts,
+                                   split_type=split_type),))
+
+    def _with(self, spec: StageSpec) -> "Pipeline":
+        return Pipeline(self._stages + (spec,))
+
+    def parse(self, format: Optional[str] = None, engine: str = "auto",
+              chunk_size: int = 8 << 20, nthreads: Optional[int] = None,
+              index_dtype=np.uint32, prefetch_depth="auto",
+              **kwargs: Any) -> "Pipeline":
+        """Bytes → CSR RowBlock stream (Parser.create; format kwargs
+        such as label_column pass through). prefetch_depth="auto" makes
+        the python engine's chunk-prefetch queue an autotuner knob."""
+        return self._with(StageSpec("parse", format=format, engine=engine,
+                                    chunk_size=chunk_size,
+                                    nthreads=nthreads,
+                                    index_dtype=index_dtype,
+                                    prefetch_depth=prefetch_depth,
+                                    **kwargs))
+
+    def shuffle(self, num_shuffle_parts: int = 4,
+                seed: int = 0) -> "Pipeline":
+        """Chunk-level shuffled read order (InputSplitShuffle): the
+        shard subdivides into num_shuffle_parts sub-shards whose order
+        reshuffles each epoch, deterministically from the seed."""
+        check(num_shuffle_parts >= 1, "num_shuffle_parts must be >= 1")
+        return self._with(StageSpec("shuffle",
+                                    num_shuffle_parts=num_shuffle_parts,
+                                    seed=seed))
+
+    def cache(self, path: str, rows_per_page: int = 64 << 10) -> "Pipeline":
+        """Parse once → binary row pages at ``path``; later epochs
+        replay pages (DiskRowIter) instead of re-parsing text."""
+        return self._with(StageSpec("cache", path=path,
+                                    rows_per_page=rows_per_page))
+
+    def batch(self, rows: int, drop_remainder: bool = False) -> "Pipeline":
+        """Re-chunk the block stream to exactly ``rows`` rows per block
+        (last partial block kept unless drop_remainder)."""
+        return self._with(StageSpec("batch", rows=rows,
+                                    drop_remainder=drop_remainder))
+
+    def map(self, fn: Callable, name: Optional[str] = None) -> "Pipeline":
+        """Apply ``fn`` to every item. ``fn`` sees items under the
+        upstream lifetime contract (copy before retaining ephemeral
+        native blocks)."""
+        return self._with(StageSpec("map", fn=fn, name=name or "map"))
+
+    def prefetch(self, depth="auto") -> "Pipeline":
+        """Decouple producer and consumer with a bounded background
+        queue; depth="auto" is an autotuner knob."""
+        return self._with(StageSpec("prefetch", depth=depth))
+
+    def shard(self, mesh, axis: str = "data", row_bucket: int = 1 << 14,
+              nnz_bucket: int = 1 << 18, **kwargs: Any) -> "Pipeline":
+        """Device-granular multi-host ingest: lowers source+parse into
+        ShardedRowBlockIter and yields global [D, ...] jax.Array batch
+        dicts sharded on the mesh's ``axis``."""
+        return self._with(StageSpec("shard", mesh=mesh, axis=axis,
+                                    row_bucket=row_bucket,
+                                    nnz_bucket=nnz_bucket, **kwargs))
+
+    def to_device(self, device=None, sharding=None,
+                  window="auto") -> "Pipeline":
+        """Async host→device transfers, ``window`` in flight;
+        window="auto" is an autotuner knob."""
+        return self._with(StageSpec("to_device", device=device,
+                                    sharding=sharding, window=window))
+
+    # -- compilation
+
+    def build(self, autotune: bool = False,
+              **autotune_opts: Any) -> CompiledPipeline:
+        """Validate the chain and lower it onto the existing iterator
+        machinery. ``autotune=True`` binds an Autotuner over every
+        "auto" depth knob (no-op when the chain has none)."""
+        specs = self._stages
+        validate_chain(specs)
+        kinds = [s.kind for s in specs]
+        if "parse" not in kinds and "shard" not in kinds:
+            raise DMLCError(
+                "pipeline: nothing to run — add .parse(...) or "
+                ".shard(mesh)")
+        source = specs[0]
+        i = 1
+        shuffle_spec = None
+        parse_spec = None
+        if i < len(specs) and specs[i].kind == "shuffle":
+            shuffle_spec = specs[i]
+            i += 1
+        if i < len(specs) and specs[i].kind == "parse":
+            parse_spec = specs[i]
+            i += 1
+        runners: List[_RunnerBase] = []
+        if i < len(specs) and specs[i].kind == "cache":
+            runners.append(_CacheRunner(source, shuffle_spec, parse_spec,
+                                        specs[i]))
+            i += 1
+        elif i < len(specs) and specs[i].kind == "shard":
+            runners.append(_ShardRunner(source, parse_spec, specs[i]))
+            i += 1
+        else:
+            runners.append(_ParseRunner(source, shuffle_spec, parse_spec))
+        for spec in specs[i:]:
+            up = runners[-1]
+            if spec.kind == "batch":
+                runners.append(_BatchRunner(up, spec.params["rows"],
+                                            spec.params["drop_remainder"]))
+            elif spec.kind == "map":
+                runners.append(_MapRunner(up, spec.params["fn"],
+                                          spec.params["name"]))
+            elif spec.kind == "prefetch":
+                runners.append(_PrefetchRunner(up, spec.params["depth"]))
+            elif spec.kind == "to_device":
+                runners.append(_DeviceRunner(up, spec.params["device"],
+                                             spec.params["sharding"],
+                                             spec.params["window"]))
+            else:  # pragma: no cover — validate_chain rejects these
+                raise DMLCError(f"pipeline: unexpected stage {spec.kind!r}")
+        tuner = None
+        if autotune:
+            knobs = [k for r in runners for k in r.knobs()]
+            if knobs:
+                tuner = Autotuner(knobs, **autotune_opts)
+        return CompiledPipeline(runners, tuner)
+
+    # -- introspection
+
+    @property
+    def stages(self) -> Tuple[StageSpec, ...]:
+        return self._stages
+
+    def __repr__(self) -> str:
+        return "Pipeline(" + " → ".join(map(repr, self._stages)) + ")"

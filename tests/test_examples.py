@@ -33,6 +33,11 @@ class TestExamples:
         r = _run("data_pipeline.py")
         assert r.returncode == 0, r.stderr[-3000:]
 
+    def test_pipeline_quickstart(self):
+        r = _run("pipeline_quickstart.py")
+        assert r.returncode == 0, r.stderr[-3000:]
+        assert "pipeline quickstart OK" in r.stdout
+
     def test_train_sparse_linear(self):
         r = _run("train_sparse_linear.py")
         assert r.returncode == 0, r.stderr[-3000:]

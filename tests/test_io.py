@@ -122,6 +122,27 @@ class TestLocalFS:
             import io
             assert io.BufferedReader(s.as_file()).readline() == b"line1\n"
 
+    def test_as_file_does_not_own_stream_by_default(self, tmp_path):
+        # ADVICE r5: a temporary adapter (GC'd or closed) must not close
+        # the stream out from under its owner mid-`with`
+        p = str(tmp_path / "own.txt")
+        with create_stream(p, "w") as s:
+            f = s.as_file()
+            f.write(b"a\n")
+            f.close()          # adapter gone...
+            s.write(b"b\n")    # ...stream still usable by its owner
+        with create_stream(p, "r") as s:
+            assert s.read_all() == b"a\nb\n"
+
+    def test_as_file_own_stream_transfers_ownership(self, tmp_path):
+        p = str(tmp_path / "own2.txt")
+        with open(p, "w") as f:
+            f.write("x")
+        s = create_stream(p, "r")
+        s.as_file(own_stream=True).close()
+        # FileStream drops its file object on close — ownership moved
+        assert s._f is None
+
 
 class TestTemporaryDirectory:
     def test_create_delete(self):

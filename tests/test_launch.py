@@ -8,8 +8,8 @@ import sys
 import pytest
 
 from dmlc_tpu.parallel.launch import (
-    find_free_port, get_link_map, get_ring, get_tree, launch_local,
-    launch_ssh, worker_envs, main,
+    find_free_port, find_free_ports, get_link_map, get_ring, get_tree,
+    launch_local, launch_ssh, worker_envs, main,
 )
 from dmlc_tpu.utils.logging import DMLCError
 
@@ -62,6 +62,17 @@ class TestEnvContract:
     def test_find_free_port(self):
         p = find_free_port()
         assert 0 < p < 65536
+
+    def test_find_free_ports_distinct(self):
+        # ADVICE r5: probes held open together must never hand out the
+        # same port twice (jax coordinator vs PS root collision)
+        ports = find_free_ports(8)
+        assert len(set(ports)) == 8
+        assert all(0 < p < 65536 for p in ports)
+
+    def test_find_free_ports_bad_n(self):
+        with pytest.raises(DMLCError):
+            find_free_ports(0)
 
 
 class TestLocalLaunch:
@@ -165,3 +176,32 @@ class TestLaunchRegressions:
                 pid = int(pid_file.read_text())
                 with pytest.raises(OSError):
                     os.kill(pid, 0)  # process must be gone
+
+    def test_dead_worker_kills_waiting_gang(self, tmp_path):
+        """ADVICE r5: with num_servers > 0 and NO timeout, a worker
+        dying at startup used to leave scheduler/server processes
+        (blocked waiting for the full world) running forever —
+        launch_local hung on the sequential waits. The gang poll must
+        kill the survivors and raise promptly with the codes."""
+        import time
+        script = tmp_path / "node.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "role = os.environ.get('DMLC_ROLE', 'worker')\n"
+            "if role == 'worker' and os.environ['DMLC_TASK_ID'] == '0':\n"
+            "    sys.exit(7)  # dies at startup\n"
+            f"open(r'{tmp_path}' + f'/pid-{{role}}-' +\n"
+            "     os.environ.get('DMLC_TASK_ID', 'x'), 'w')"
+            ".write(str(os.getpid()))\n"
+            "time.sleep(300)  # 'waiting for the world to register'\n")
+        t0 = time.monotonic()
+        with pytest.raises(DMLCError, match="exit codes"):
+            launch_local(2, [sys.executable, str(script)],
+                         num_servers=1)  # note: timeout=None
+        elapsed = time.monotonic() - t0
+        assert elapsed < 60, f"hung {elapsed:.0f}s instead of failing fast"
+        time.sleep(0.2)
+        for pid_file in tmp_path.glob("pid-*"):
+            pid = int(pid_file.read_text())
+            with pytest.raises(OSError):
+                os.kill(pid, 0)  # survivors must have been killed
