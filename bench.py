@@ -19,7 +19,8 @@ reference run (DMLC_TPU_BENCH_HANDWIRED_EPOCHS, default 3) reports
 Prints exactly ONE JSON line: {"metric", "value", "unit",
 "vs_baseline", "best_epoch", "epochs", "bound", "parse_cpu_gbps_core",
 "sustained_gauge_ok", "gauge_ok_epochs", "gauge_ok_threshold",
-"epoch_gauges", "replay_gbps", "handwired_gbps", "pipeline"} —
+"epoch_gauges", "gauge_bands", "run_band", "replay_gbps", "replay",
+"replay_tier", "handwired_gbps", "pipeline"} —
 "value" is the SUSTAINED rate (20%-trimmed mean of per-epoch GB/s over
 >= 5 epochs / >= the time budget), "best_epoch" the fastest single
 epoch, "parse_cpu_gbps_core" the thread-CPU parse rate (immune to this
@@ -27,13 +28,21 @@ burstable VM's credit scheduler), "sustained_gauge_ok" the same
 trimmed mean restricted to epochs whose pre-epoch host-memcpy gauge
 cleared "gauge_ok_threshold" (credit-healthy epochs only — the
 cross-run-comparable number; per-epoch gauges ride in "epoch_gauges"),
-"replay_gbps" the parse-once/replay-epochs page rate in
-text-equivalent GB/s (the repeated-epoch training shape; "value"
-deliberately excludes it), "bound" whether the best epoch waited
-mainly on transfers or on parse, "pipeline" the best epoch's per-stage
-stats snapshot + the autotune report, and vs_baseline is value / 2.0
-(the BASELINE.json target of 2 GB/s/chip; the reference publishes no
-numbers of its own, see BASELINE.md).
+"gauge_bands" the same statistic split per comparability class
+(BASELINE.md's credit-recovery bands: drained < 1.0, plateau 1.0-1.6,
+elevated 1.6-3.0, full >= 3.0 GB/s memcpy) with "run_band" the run's
+modal band — numbers from runs on different credit days compare within
+a band without rerunning, "replay" the parse-once/replay-epochs page
+probe (>= 3 gauge-tagged replay epochs: replay_best / replay_sustained
+text-equivalent GB/s + build cost; "replay_gbps" keeps the best rate
+for older readers; "value" deliberately excludes replay),
+"replay_tier" the page-SPILL steady-replay probe (ShardedRowBlockIter
+forced over its cache budget: parse-epoch vs page-replay-epoch rates
+and their speedup — the ISSUE-2 acceptance number), "bound" whether
+the best epoch waited mainly on transfers or on parse, "pipeline" the
+best epoch's per-stage stats snapshot + the autotune report, and
+vs_baseline is value / 2.0 (the BASELINE.json target of 2 GB/s/chip;
+the reference publishes no numbers of its own, see BASELINE.md).
 
 Secondary diagnostics go to stderr.
 """
@@ -235,6 +244,38 @@ def main() -> None:
                           if len(ok_rates) >= 3 else None)
     log(f"gauge-ok epochs: {len(ok_rates)}/{len(times)} "
         f"(threshold {GAUGE_OK_GBPS} GB/s memcpy)")
+
+    # Band-split sustained rates (BASELINE.md "Credit-recovery
+    # profile"): the memcpy gauge separates comparability classes —
+    # drained (< 1.0), the post-recovery plateau (1.0-1.6), elevated
+    # (1.6-3.0) and full-bucket (>= 3.0, a long-rested VM). Numbers
+    # compare ACROSS runs only within one band; the run's modal band is
+    # stamped so two BASELINE rows can be read side by side without
+    # rerunning either.
+    def gauge_band(g):
+        if g < 1.0:
+            return "drained"
+        if g < 1.6:
+            return "plateau"
+        if g < 3.0:
+            return "elevated"
+        return "full"
+
+    band_rates = {}
+    for t, g in times:
+        band_rates.setdefault(gauge_band(g), []).append(size / t / 1e9)
+    gauge_bands = {
+        band: {"epochs": len(rates),
+               # same >= 3-epoch rule as sustained_gauge_ok: fewer make
+               # a trimmed mean meaningless
+               "sustained": (round(trimmed_mean(rates), 4)
+                             if len(rates) >= 3 else None)}
+        for band, rates in sorted(band_rates.items())}
+    run_band = max(band_rates, key=lambda b: len(band_rates[b]))
+    log(f"gauge bands: " + ", ".join(
+        f"{b}={v['epochs']}ep"
+        + (f"@{v['sustained']}" if v["sustained"] else "")
+        for b, v in gauge_bands.items()) + f"; run_band={run_band}")
     if best_stats:
         # per-stage breakdown (VERDICT r1 #7): where the best epoch's
         # time went (shared formatter with the bench suite)
@@ -249,21 +290,69 @@ def main() -> None:
             f"tuned={autotune_report['tuned']} "
             f"decisions={len(autotune_report['decisions'])}")
 
-    # Page-replay rate (VERDICT r4 #2): the repeated-epoch training
-    # shape — parse once into binary pages, replay pages → HBM on every
-    # later epoch (DiskRowIter; ShardedRowBlockIter replays in-memory
-    # rounds the same way). Reported ALONGSIDE the headline: "value"
-    # stays the true parse rate, replay must not inflate it.
+    # Page-replay rate (VERDICT r4 #2, defensible since r6): the
+    # repeated-epoch training shape — parse once into binary pages,
+    # replay pages → HBM on every later epoch (DiskRowIter;
+    # ShardedRowBlockIter replays retained rounds the same way). >= 3
+    # replay epochs, each gauge-tagged, with best AND sustained
+    # reported: a single post-drain epoch undersold config 8 by ~5x
+    # (r5 measured replay_gbps 0.26 vs config 8's 1.4-2.0). Reported
+    # ALONGSIDE the headline: "value" stays the true parse rate,
+    # replay must not inflate it.
     replay_gbps = None
+    replay = None
     if os.environ.get("DMLC_TPU_BENCH_REPLAY", "1") != "0":
         try:
             from dmlc_tpu.bench_suite import bench_page_replay
-            rp = bench_page_replay(min(SIZE_MB, 64))
-            replay_gbps = rp["text_equiv_gbps"]
-            log(f"page replay: {replay_gbps} GB/s text-equivalent "
-                f"({rp['gbps']:.3f} page-GB/s, build {rp['build_s']}s)")
+            rp_epochs = int(os.environ.get("DMLC_TPU_BENCH_REPLAY_EPOCHS",
+                                           "5"))
+            rp = bench_page_replay(min(SIZE_MB, 64), epochs=rp_epochs,
+                                   gauge_fn=memcpy_gauge)
+            # unrounded-wall rates from the suite (the display-rounded
+            # epoch_walls would quantize ~30 ms epochs by percents)
+            rp_rates = rp["epoch_rates_text_gbps"]
+            replay_gbps = rp["text_equiv_gbps"]  # best epoch
+            replay = {
+                "replay_best": replay_gbps,
+                "replay_sustained": round(trimmed_mean(rp_rates), 4),
+                "epoch_walls": rp["epoch_walls"],
+                "epoch_gauges": rp["epoch_gauges"],
+                "build_s": rp["build_s"],
+                "page_gbps": round(rp["gbps"], 4),
+            }
+            log(f"page replay: best {replay_gbps} / sustained "
+                f"{replay['replay_sustained']} GB/s text-equivalent "
+                f"over {len(rp_rates)} epochs (gauges "
+                f"{rp['epoch_gauges']}, build {rp['build_s']}s)")
         except Exception as e:  # noqa: BLE001 — diagnostics must not
             log(f"page replay measurement failed: {e}")  # kill the run
+
+    # Page-SPILL steady replay (r6 tentpole, the ISSUE-2 acceptance
+    # probe): a config-7-style iterator forced over its cache budget —
+    # steady epochs must serve from spilled round pages at >= 2x the
+    # parse-epoch rate.
+    replay_tier = None
+    if os.environ.get("DMLC_TPU_BENCH_SPILL", "1") != "0":
+        try:
+            from dmlc_tpu.bench_suite import bench_spill_replay
+            sr = bench_spill_replay(min(SIZE_MB, 64),
+                                    gauge_fn=memcpy_gauge)
+            replay_tier = {
+                "mode": sr["mode"],
+                "parse_epoch_gbps": sr["parse_epoch_gbps"],
+                "parse_epoch_gauge": sr["parse_epoch_gauge"],
+                "spill_epoch_gbps": sr["spill_epoch_gbps"],
+                "replay_gbps": round(sr["gbps"], 4),
+                "replay_sustained_gbps": sr["replay_sustained_gbps"],
+                "speedup_vs_parse": sr["speedup_vs_parse"],
+                "epoch_gauges": sr["epoch_gauges"],
+                "rounds": sr["rounds"],
+            }
+            log(f"page-spill steady replay: {sr['gbps']:.3f} GB/s "
+                f"text-equivalent vs {sr['parse_epoch_gbps']} parse "
+                f"({sr['speedup_vs_parse']}x, tier={sr['mode']})")
+        except Exception as e:  # noqa: BLE001 — diagnostics must not
+            log(f"page-spill replay measurement failed: {e}")
 
     best_gbps = size / best / 1e9
     # Credit-immune kernel rate (VERDICT r3 #4): thread-CPU time spent
@@ -301,9 +390,21 @@ def main() -> None:
         "gauge_ok_epochs": len(ok_rates),
         "gauge_ok_threshold": GAUGE_OK_GBPS,
         "epoch_gauges": [round(g, 2) for _, g in times],
+        # per-comparability-class sustained rates + this run's modal
+        # band (BASELINE.md credit-recovery bands): cross-run reads
+        # compare within a band only
+        "gauge_bands": gauge_bands,
+        "run_band": run_band,
         # parse-once/replay-epochs rate in text-equivalent GB/s (the
-        # repeated-epoch training shape); null if the probe failed
+        # repeated-epoch training shape); null if the probe failed.
+        # replay_gbps keeps the BEST single epoch (older readers);
+        # "replay" carries best + sustained + per-epoch gauges/walls
         "replay_gbps": replay_gbps,
+        "replay": replay,
+        # page-SPILL steady replay: the over-budget iterator serving
+        # steady epochs from spilled round pages (mode/rates/speedup);
+        # null if the probe failed
+        "replay_tier": replay_tier,
         # the pre-r6 hand-wired loop's best-of-N reference (null when
         # DMLC_TPU_BENCH_HANDWIRED_EPOCHS=0): the pipeline-built path
         # above must not sit below it

@@ -64,8 +64,11 @@ def main() -> int:
         json.dump({"rank": pid, "world": nprocs, "batches": nbatches,
                    "epoch_walls": epoch_walls,
                    # epochs 2-3 should serve from the retained rounds
-                   # (steady replay, VERDICT r4 #2)
-                   "replay_epochs": it.replay_epochs}, f)
+                   # (steady replay, VERDICT r4 #2); r6 adds which TIER
+                   # served (memory within budget / pages above it)
+                   "replay_epochs": it.replay_epochs,
+                   "page_replay_epochs": it.page_replay_epochs,
+                   "replay_tier": it.replay_tier}, f)
     finalize()
     return 0
 

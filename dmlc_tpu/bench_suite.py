@@ -24,6 +24,11 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                onto the config-1 machinery: parse → batch → prefetch
                with per-stage telemetry and autotuned depths,
                content-hash parity vs the direct parse
+ 10. spill_replay — page-SPILL steady replay (r6): ShardedRowBlockIter
+               forced over its agreement_cache_bytes budget, steady
+               epochs served from the spilled round pages; reports the
+               page-replay vs parse-epoch speedup (the larger-than-RAM
+               training shape)
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 """
@@ -551,11 +556,14 @@ def bench_multiprocess_ingest(mb: int) -> Dict:
             "first_epoch_gbps": round(size / first / 1e9, 4),
             "steady_over_first": round(first / steady, 2),
             # steady epochs serve retained rounds (no re-parse) when
-            # the shard fit the cache budget — the r5 replay path
-            "replay_epochs": results[0].get("replay_epochs", 0)}
+            # the shard fit the cache budget — the r5 replay path; r6
+            # adds the serving tier (memory / pages)
+            "replay_epochs": results[0].get("replay_epochs", 0),
+            "replay_tier": results[0].get("replay_tier")}
 
 
-def bench_page_replay(mb: int, rows_per_page: int = 8 << 10) -> Dict:
+def bench_page_replay(mb: int, rows_per_page: int = 8 << 10,
+                      epochs: int = 3, gauge_fn=None) -> Dict:
     """Binary page replay → device HBM, parse skipped (VERDICT r3 #2).
 
     The reference's own larger-than-RAM answer to "parse is expensive"
@@ -571,7 +579,10 @@ def bench_page_replay(mb: int, rows_per_page: int = 8 << 10) -> Dict:
     measured transfer sweet spot (BASELINE.md "Transfer ceiling").
     Reports gbps over PAGE bytes (the IO this path performs) and
     text_equiv_gbps over the text bytes the replay stands in for
-    (comparable with config 1's parse number)."""
+    (comparable with config 1's parse number). ``epochs`` replay passes
+    are timed (>= 3 so a burst-shaper stall cannot be the whole story);
+    ``gauge_fn`` (e.g. bench_transfer.memcpy_gauge) tags each epoch
+    with a pre-epoch credit gauge so a reader can band the walls."""
     import jax
 
     from dmlc_tpu.data.row_iter import DiskRowIter, RowBlockIter
@@ -610,7 +621,12 @@ def bench_page_replay(mb: int, rows_per_page: int = 8 << 10) -> Dict:
             jax.block_until_ready(fut)
         return time.perf_counter() - t0
 
-    walls = [replay_epoch() for _ in range(3)]
+    gauges = []
+    walls = []
+    for _ in range(max(3, epochs)):
+        if gauge_fn is not None:
+            gauges.append(round(float(gauge_fn()), 2))
+        walls.append(replay_epoch())
     best = min(walls)
     # parity: replayed pages == direct parse, byte-identical CSR
     c = RowBlockContainer(np.uint32)
@@ -626,10 +642,93 @@ def bench_page_replay(mb: int, rows_per_page: int = 8 << 10) -> Dict:
             "text_equiv_gbps": round(size / best / 1e9, 4),
             "build_s": round(build_s, 3),
             "epoch_walls": [round(w, 3) for w in walls],
+            # rates computed from the UNROUNDED walls: ~30 ms epochs
+            # would pick up percent-level quantization error (or a
+            # div-by-zero on sub-ms walls) from the display-rounded
+            # epoch_walls — exactly what a "defensible" replay number
+            # must not do
+            "epoch_rates_text_gbps": [round(size / w / 1e9, 4)
+                                      for w in walls],
+            "epoch_gauges": gauges or None,
             # a CPU-backend run measures host-to-host copies, not HBM —
             # the platform disambiguates the number
             "platform": dev.platform,
             "hash": replay_hash}
+
+
+def bench_spill_replay(mb: int, gauge_fn=None, replay_epochs: int = 5,
+                       row_bucket: int = 1 << 14,
+                       nnz_bucket: int = 1 << 19) -> Dict:
+    """Page-SPILL steady replay — the larger-than-RAM training shape
+    (r6 tentpole): a ShardedRowBlockIter whose ``agreement_cache_bytes``
+    sits far below the shard's round bytes, so the replay tee spills
+    the epoch's rounds to a binary page file and every steady epoch
+    serves pages instead of re-parsing text (config-7 cadence with the
+    memory tier deliberately forced out). Epoch 1 is the parse epoch,
+    epoch 2 re-parses + spills (the tee), epochs 3+ are gauge-tagged
+    page-replay epochs reported as best AND sustained (>= 5 epochs —
+    the first replay epoch pays allocator warm-up). speedup_vs_parse
+    is the ISSUE-2 acceptance number: replay is memcpy-bound
+    (pad+stack+transfer ≈ 2× padded bytes) while the parse epoch adds
+    the text kernel on top, so the ratio floats with the credit gauge
+    — ~1.6-2× against a warm-burst parse window on this host, 2-7×
+    against the drained/cold parse epochs the re-parse path actually
+    pays (see BASELINE.md; both sides' gauges ride in the JSON)."""
+    import jax
+    import numpy as _np
+
+    from jax.sharding import Mesh
+
+    from dmlc_tpu.parallel.sharded import ShardedRowBlockIter
+
+    path = f"{_TMP}.spillrep.libsvm"
+    size = make_libsvm(path, mb, seed=7, nnz_range=(25, 45),
+                       index_space=10 ** 6, real_values=True)
+    mesh = Mesh(_np.array(jax.devices()[:1]).reshape(1), ("data",))
+    it = ShardedRowBlockIter(path, mesh, format="libsvm",
+                             row_bucket=row_bucket, nnz_bucket=nnz_bucket,
+                             agreement_cache_bytes=1 << 20,  # << shard
+                             first_epoch_cache="never")
+
+    def epoch() -> float:
+        t0 = time.perf_counter()
+        for batch in it:
+            jax.block_until_ready(batch["value"])
+        return time.perf_counter() - t0
+
+    parse_gauge = (round(float(gauge_fn()), 2)
+                   if gauge_fn is not None else None)
+    parse_wall = epoch()          # parse epoch 1 (no tee: "never")
+    spill_wall = epoch()          # re-parse + spill write (the tee)
+    assert it.replay_tier == "parse", it.replay_tier
+    gauges = []
+    replay_walls = []
+    for _ in range(max(3, replay_epochs)):
+        if gauge_fn is not None:
+            gauges.append(round(float(gauge_fn()), 2))
+        replay_walls.append(epoch())
+    assert it.replay_tier == "pages", it.replay_tier
+    assert it.page_replay_epochs >= 3, it.page_replay_epochs
+    spill_file = it._round_store.file
+    page_bytes = os.path.getsize(spill_file.path)
+    it.close()
+    rates = sorted(size / w / 1e9 for w in replay_walls)
+    best = rates[-1]
+    k = len(rates) // 5
+    sustained = sum(rates[k:len(rates) - k]) / len(rates[k:len(rates) - k])
+    parse_gbps = size / parse_wall / 1e9
+    return {"config": "page_spill_steady_replay", "mode": "pages",
+            "gbps": best,                        # text-equivalent
+            "replay_sustained_gbps": round(sustained, 4),
+            "bytes": size, "page_bytes": page_bytes,
+            "parse_epoch_gbps": round(parse_gbps, 4),
+            "parse_epoch_gauge": parse_gauge,
+            "spill_epoch_gbps": round(size / spill_wall / 1e9, 4),
+            "replay_epoch_walls": [round(w, 3) for w in replay_walls],
+            "epoch_gauges": gauges or None,
+            "speedup_vs_parse": round(best / parse_gbps, 2),
+            "rounds": spill_file.rounds,
+            "platform": jax.devices()[0].platform}
 
 
 def bench_pipeline(mb: int) -> Dict:
@@ -683,13 +782,14 @@ CONFIGS = {
     7: ("multiprocess", lambda mb, dev: bench_multiprocess_ingest(mb)),
     8: ("page_replay", lambda mb, dev: bench_page_replay(mb)),
     9: ("pipeline", lambda mb, dev: bench_pipeline(mb)),
+    10: ("spill_replay", lambda mb, dev: bench_spill_replay(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-9 (0 = all)")
+                    help="1-10 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -704,10 +804,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         try:
             # config 7's steady-state metric already self-warms (epochs
             # 2-3 of one gang), config 8 takes best-of-3 replay epochs
-            # over a build it performs itself, and config 9 runs three
-            # epochs of one pipeline — a second full run of any would
+            # over a build it performs itself, configs 9/10 run several
+            # epochs of one iterator — a second full run of any would
             # be pure wasted minutes
-            if not args.cold and n not in (7, 8, 9):
+            if not args.cold and n not in (7, 8, 9, 10):
                 fn(args.mb, args.device)  # warm imports + page cache
             out = fn(args.mb, args.device)
             out["gbps"] = round(out["gbps"], 4)

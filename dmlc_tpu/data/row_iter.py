@@ -1,16 +1,28 @@
-"""Row-block iterators: in-RAM and disk-cached.
+"""Row-block iterators: in-RAM and disk-cached, plus the round spill
+store backing ShardedRowBlockIter's page-tier steady replay.
 
 Reference: src/data/basic_row_iter.h (BasicRowIter<I> — drain parser into
 one RowBlockContainer at construction), src/data/disk_row_iter.h
 (DiskRowIter<I> — parse once, spill binary pages to a '#cache' file, then
 replay pages with ThreadedIter prefetch), include/dmlc/data.h
 (RowBlockIter<I>::Create).
+
+The spill store (RoundSpillWriter / RoundSpillFile) is DiskRowIter's
+page format generalized to ROUNDS: each round is a fixed-width row of
+``nparts`` raw (unpadded) RowBlocks, written round-major as the replay
+tee assembles them, fingerprint-stamped in the header so staleness is
+self-describing (``sweep_stale_spill``), committed atomically via
+tmp + rename. ShardedRowBlockIter replays these rounds on steady epochs
+when the in-memory tier would exceed ``agreement_cache_bytes``.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import tempfile
+import time
+from typing import Any, Iterator, List, Optional
 
 import numpy as np
 
@@ -19,9 +31,12 @@ from dmlc_tpu.data.rowblock import RowBlock, RowBlockContainer
 from dmlc_tpu.data.threaded_iter import ThreadedIter
 from dmlc_tpu.io.stream import create_stream
 from dmlc_tpu.io.uri_spec import URISpec
-from dmlc_tpu.utils.logging import DMLCError, check
+from dmlc_tpu.utils import serializer as ser
+from dmlc_tpu.utils.logging import DMLCError, check, check_eq
 
-__all__ = ["RowBlockIter", "BasicRowIter", "DiskRowIter"]
+__all__ = ["RowBlockIter", "BasicRowIter", "DiskRowIter",
+           "RoundSpillWriter", "RoundSpillFile", "default_spill_dir",
+           "read_spill_meta", "sweep_stale_spill"]
 
 
 class RowBlockIter(DataIter):
@@ -122,7 +137,24 @@ class DiskRowIter(RowBlockIter):
 
     def _build_cache(self, parser: Parser, cache_file: str,
                      rows_per_page: int) -> None:
-        tmp = cache_file + ".tmp"
+        # pid-unique tmp: two processes racing to build the same cache
+        # (the derived-path pipeline tier makes that reachable) must not
+        # interleave writes into one tmp — each builds its own, the
+        # replaces are atomic, last complete build wins. Dead writers'
+        # orphans are reaped HERE (the retry site) as well as by
+        # sweep_stale_spill, because explicit cache paths live outside
+        # the spill dir and would otherwise accumulate one dataset-
+        # sized orphan per crashed build.
+        import glob
+        import re
+        for orphan in glob.glob(glob.escape(cache_file) + ".tmp.*"):
+            m = re.search(r"\.tmp\.(\d+)$", orphan)
+            if m and _pid_dead(int(m.group(1))):
+                try:
+                    os.remove(orphan)
+                except OSError:
+                    pass
+        tmp = f"{cache_file}.tmp.{os.getpid()}"
         with create_stream(tmp, "w") as out:
             pending = RowBlockContainer(parser.index_dtype)
             parser.before_first()
@@ -194,3 +226,275 @@ class DiskRowIter(RowBlockIter):
             self._close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Round spill store — the page tier of ShardedRowBlockIter steady replay
+# ---------------------------------------------------------------------------
+
+_SPILL_MAGIC = 0x53504C4C      # "SPLL"
+_SPILL_END_MAGIC = 0x454E4453  # "ENDS"
+_SPILL_VERSION = 1
+
+
+def default_spill_dir() -> str:
+    """Where fingerprint-keyed spill files live unless the caller names
+    a directory (ShardedRowBlockIter(spill_dir=...))."""
+    return os.path.join(tempfile.gettempdir(), "dmlc_tpu_spill")
+
+
+# spill dirs this process has written into: sweep_stale_spill(None)
+# covers them all, so custom spill_dir users get the same resume-
+# boundary hygiene as the default dir (in-process knowledge only —
+# another process's custom dir is swept by that process's own restores)
+_KNOWN_SPILL_DIRS = set()
+
+
+class RoundSpillWriter:
+    """Append rounds of raw RowBlocks to a page file; commit atomically.
+
+    Layout: header (magic, version, nparts, JSON meta carrying the
+    source fingerprint) → ``rounds`` × ``nparts`` RowBlock pages
+    (RowBlockContainer.save_block — the DiskRowIter page format) →
+    footer (end magic, round count). Writes go to ``path + ".tmp"`` and
+    land via os.replace only on commit, so a crashed or aborted spill
+    never masquerades as a complete cache.
+    """
+
+    def __init__(self, path: str, nparts: int,
+                 meta: Optional[dict] = None):
+        check(1 <= nparts <= 255, "spill nparts out of range")
+        self.path = path
+        self.nparts = nparts
+        self.rounds = 0
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+            _KNOWN_SPILL_DIRS.add(d)
+        self._tmp = path + ".tmp"
+        self._s = create_stream(self._tmp, "w")
+        ser.write_u32(self._s, _SPILL_MAGIC)
+        ser.write_u8(self._s, _SPILL_VERSION)
+        ser.write_u8(self._s, nparts)
+        ser.write_str(self._s, json.dumps(meta or {}))
+
+    def add_row(self, blocks: List[RowBlock]) -> None:
+        """One round: exactly ``nparts`` blocks (empty pads included —
+        a zero-row page costs ~60 bytes). Arrays are serialized
+        immediately, so ephemeral (leased) blocks need no copy."""
+        check_eq(len(blocks), self.nparts, "spill row width mismatch")
+        for b in blocks:
+            RowBlockContainer.save_block(b, self._s)
+        self.rounds += 1
+
+    def commit(self) -> "RoundSpillFile":
+        ser.write_u32(self._s, _SPILL_END_MAGIC)
+        ser.write_u64(self._s, self.rounds)
+        self._s.close()
+        self._s = None
+        os.replace(self._tmp, self.path)
+        return RoundSpillFile(self.path, self.nparts, self.rounds)
+
+    def abort(self) -> None:
+        if self._s is not None:
+            try:
+                self._s.close()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+            self._s = None
+        try:
+            os.remove(self._tmp)
+        except OSError:
+            pass
+
+
+class RoundSpillFile:
+    """A committed spill file: sequential round-major replay."""
+
+    def __init__(self, path: str, nparts: int, rounds: int):
+        self.path = path
+        self.nparts = nparts
+        self.rounds = rounds
+
+    def iter_rows(self) -> Iterator[List[RowBlock]]:
+        """Yield each round's ``nparts`` raw blocks in written order."""
+        s = create_stream(self.path, "r")
+        try:
+            _read_spill_header(s)  # skip header (validates magic)
+            for _ in range(self.rounds):
+                row = []
+                for _ in range(self.nparts):
+                    blk = RowBlockContainer.load_block(s)
+                    if blk is None:
+                        raise DMLCError(
+                            f"round spill {self.path}: truncated page "
+                            "stream (file changed under an armed replay "
+                            "cache?)")
+                    row.append(blk)
+                yield row
+        finally:
+            s.close()
+
+    def delete(self) -> None:
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+
+def _read_spill_header(s) -> dict:
+    magic = ser.read_u32(s)
+    check_eq(magic, _SPILL_MAGIC, "round spill: bad magic")
+    version = ser.read_u8(s)
+    check_eq(version, _SPILL_VERSION, "round spill: bad version")
+    nparts = ser.read_u8(s)
+    meta = json.loads(ser.read_str(s))
+    meta["_nparts"] = nparts
+    return meta
+
+
+def read_spill_meta(path: str) -> Optional[dict]:
+    """Header meta of a spill file, or None when it is not one."""
+    try:
+        with create_stream(path, "r") as s:
+            return _read_spill_header(s)
+    except Exception:  # noqa: BLE001 — not a spill file / unreadable
+        return None
+
+
+def _pid_dead(pid: int) -> bool:
+    """Liveness probe for a writer pid recorded on THIS host (spill
+    dirs are host-local tmp, so the probe is meaningful). Pid reuse can
+    keep a dead file one sweep longer — bounded, accepted. The ONE
+    liveness rule for every spill/cache cleanup site."""
+    if pid == os.getpid():
+        return False
+    try:
+        os.kill(pid, 0)
+        return False
+    except ProcessLookupError:
+        return True
+    except OSError:
+        return False  # alive but not ours (EPERM) — keep
+
+
+def _spill_owner(name: str) -> Optional[int]:
+    """Writer pid embedded in a round-spill file name
+    (rounds-<key>-p<pid>-<seq>.pages[.tmp]), or None."""
+    import re
+    m = re.search(r"-p(\d+)-\d+\.pages(\.tmp)?$", name)
+    return int(m.group(1)) if m else None
+
+
+def _spill_owner_dead(name: str) -> Optional[bool]:
+    """Liveness of the writer pid a spill file's name embeds: True =
+    dead, False = alive (or us), None = no pid in the name. A dead
+    owner's file can never be adopted (names are per-instance) and
+    would otherwise outlive every sweep of a stable dataset."""
+    pid = _spill_owner(name)
+    return None if pid is None else _pid_dead(pid)
+
+
+def sweep_stale_spill(spill_dir: Optional[str] = None,
+                      max_tmp_age_s: float = 600.0) -> int:
+    """Delete spill/cache page files whose recorded source fingerprint
+    no longer matches a stat of the backing files, round-spill files
+    whose writer process is dead (crashed before its close() could
+    delete them), plus orphaned .tmp files older than ``max_tmp_age_s``
+    (younger ones may belong to a live writer). Returns files removed.
+
+    Called from ShardedCheckpoint.restore(): a restore marks a resume
+    boundary, and any page cache written against since-mutated inputs
+    must not survive into the resumed run — the mutation contract says
+    replay re-earns from a clean re-parse after the source changes.
+    Live-owner files with matching fingerprints are left alone. With
+    ``spill_dir=None`` the sweep covers the default dir plus every
+    custom dir this process has spilled into.
+    """
+    if spill_dir is None:
+        dirs = {default_spill_dir()} | set(_KNOWN_SPILL_DIRS)
+        return sum(sweep_stale_spill(d, max_tmp_age_s) for d in dirs)
+    from dmlc_tpu.io.tpu_fs import local_path
+    d = spill_dir
+    if not os.path.isdir(d):
+        return 0
+    removed = 0
+    now = time.time()
+    import re
+    names = set(os.listdir(d))
+    for name in sorted(names):
+        path = os.path.join(d, name)
+        # build temporaries come in two shapes: the round-spill tee's
+        # '<...>.pages.tmp' (writer pid embedded earlier in the name)
+        # and DiskRowIter's pid-suffixed '<...>.pages.tmp.<pid>'
+        tmp_m = re.search(r"\.tmp(?:\.(\d+))?$", name)
+        if tmp_m:
+            # a live writer's tmp is NEVER deleted, however slow the
+            # epoch (a stalled consumer can hold one open for ages);
+            # dead-owner tmps go now, anonymous ones by age only
+            if tmp_m.group(1):
+                dead = _pid_dead(int(tmp_m.group(1)))
+            else:
+                dead = _spill_owner_dead(name)
+            try:
+                if dead or (dead is None and
+                            now - os.path.getmtime(path) > max_tmp_age_s):
+                    os.remove(path)
+                    removed += 1
+            except OSError:
+                pass
+            continue
+        if name.endswith(".pages.meta.json"):
+            # sidecar without its page file (failed/crashed build):
+            # nothing will ever pair with it — sweep it directly
+            if name[:-len(".meta.json")] not in names:
+                try:
+                    os.remove(path)
+                    removed += 1
+                except OSError:
+                    pass
+            continue
+        if not name.endswith(".pages"):
+            continue
+        if _spill_owner_dead(name):
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+            continue
+        meta = read_spill_meta(path)
+        if meta is None:
+            # DiskRowIter-format page caches carry their meta in a
+            # sidecar (written by the pipeline cache stage)
+            try:
+                with open(path + ".meta.json") as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                continue  # unknowable: never delete what we can't read
+        fp = meta.get("fingerprint")
+        if not fp:
+            continue
+        stale = False
+        for entry in fp:
+            fpath, size, mtime_ns = entry[0], entry[1], entry[2]
+            try:
+                # fingerprints record scheme-bearing paths (tpu://...);
+                # stat their local backing, same as _fingerprint_now —
+                # os.stat on the raw URI would misjudge EVERY such
+                # cache stale and delete a live iterator's file
+                st = os.stat(local_path(fpath))
+            except OSError:
+                stale = True
+                break
+            if st.st_size != size or st.st_mtime_ns != mtime_ns:
+                stale = True
+                break
+        if stale:
+            for victim in (path, path + ".meta.json"):
+                try:
+                    os.remove(victim)
+                    removed += 1
+                except OSError:
+                    pass
+    return removed

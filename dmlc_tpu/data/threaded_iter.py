@@ -19,6 +19,7 @@ parity (the C++ engine does reuse arena buffers).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Generic, Optional, TypeVar
 
 from dmlc_tpu.utils.logging import DMLCError, check
@@ -39,6 +40,11 @@ class ThreadedIter(Generic[T]):
         self._not_full = threading.Condition(self._lock)
         self._queue: list = []
         self._epoch = 0           # consumer's current epoch
+        self._produced = 0        # items enqueued this epoch
+        self._producer_block_s = 0.0  # producer time blocked on a full
+        # queue this epoch — with qsize() this tells producer-bound
+        # (empty queue, no block time) from consumer-bound (full queue,
+        # producer waiting) at the probe/autotuner layer
         self._producer_wake = threading.Event()
         self._destroyed = False
         self._ended = False
@@ -105,13 +111,20 @@ class ThreadedIter(Generic[T]):
         _not_full under the lock, so no polling wake-ups are needed.
         """
         with self._lock:
+            t0 = None
             while len(self._queue) >= self._cap:
                 if self._destroyed or self._epoch != epoch:
                     return False
+                if t0 is None:
+                    t0 = time.perf_counter()
                 self._not_full.wait()
+            if t0 is not None:
+                self._producer_block_s += time.perf_counter() - t0
             if self._destroyed or self._epoch != epoch:
                 return False
             self._queue.append((epoch, kind, payload))
+            if kind == _DATA:
+                self._produced += 1
             self._not_empty.notify()
             return True
 
@@ -151,6 +164,15 @@ class ThreadedIter(Generic[T]):
         with self._lock:
             return len(self._queue)
 
+    def stats(self) -> dict:
+        """Epoch-scoped producer counters (reset by before_first):
+        items produced and seconds the producer spent blocked on a full
+        queue — the shard serve path and pipeline probes surface these
+        so a reader can tell which side of the queue was the limit."""
+        with self._lock:
+            return {"produced": self._produced,
+                    "producer_block_s": round(self._producer_block_s, 6)}
+
     @property
     def capacity(self) -> int:
         return self._cap
@@ -171,6 +193,8 @@ class ThreadedIter(Generic[T]):
         with self._lock:
             self._epoch += 1
             self._queue.clear()
+            self._produced = 0
+            self._producer_block_s = 0.0
             self._not_full.notify_all()
         self._ended = False
         self._producer_wake.set()

@@ -345,8 +345,22 @@ class ShardedCheckpoint:
         jax.make_array_from_single_device_arrays — no full-array host
         materialization. Unsharded leaves (or ``like=None``) fall back
         to full assembly. ``last_restore_bytes_read`` records the data
-        bytes actually read from shard files."""
+        bytes actually read from shard files.
+
+        Restore also sweeps the replay page-cache spill dir
+        (best-effort): a restore marks a resume boundary, and spill
+        files written against inputs that have since changed must not
+        be adoptable by the resumed run — the steady-replay mutation
+        contract says replay re-earns from a clean re-parse after any
+        source change. Only files whose recorded fingerprint fails a
+        re-stat (plus crashed writers' orphaned .tmp files) are
+        deleted; caches of unchanged sources are untouched."""
         import jax
+        try:
+            from dmlc_tpu.data.row_iter import sweep_stale_spill
+            sweep_stale_spill()
+        except Exception:  # noqa: BLE001 — hygiene must not block restore
+            pass
         if step is None:
             step = self.latest_step()
             check(step is not None, f"no committed checkpoint under {self.root}")

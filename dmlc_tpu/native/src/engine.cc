@@ -688,21 +688,35 @@ class ShardReaderBase {
     if (mmap_failed_) return kUnavailable;
     if (cur_ >= end_) return kEnd;
     int i = FileIndexOf(cur_);
-    const char* base = MapFile(i);
-    if (!base) return kUnavailable;
+    int64_t lo = 0;
+    const char* mbase = MapFile(i, &lo);
+    if (!mbase) return kUnavailable;
     int64_t avail_end = std::min(prefix_[i + 1], end_);
     int64_t off = cur_ - prefix_[i];
     int64_t limit = avail_end - prefix_[i];
     int64_t target = std::min<int64_t>(off + chunk_bytes_, limit);
+    // offsets into CutViewChunk are relative to the mapped slice (the
+    // map covers [lo, hi) of the file, not the whole file)
     int64_t cut = (target < limit)
-                      ? CutViewChunk(base, off, target, limit)
+                      ? CutViewChunk(mbase, off - lo, target - lo,
+                                     limit - lo) + lo
                       : limit;
-    *p = base + off;
+    *p = mbase + (off - lo);
     *n = (size_t)(cut - off);
     bytes_read_ += (int64_t)*n;
     cur_ = prefix_[i] + cut;
     return kView;
   }
+
+  // Drop the lazy file mappings; the next MapFile remaps. For use once
+  // a run has fully drained (the text parser pipeline calls it at EOF,
+  // when every worker has exited and no chunk view is in flight).
+  // Record readers hand mapped views to consumers as leases and must
+  // NOT call this. Why: view RSS otherwise persists for the reader's
+  // lifetime — and on kernels that charge a whole mapping to RSS at
+  // first touch (gVisor-class, this build host), a gang of P live
+  // parsers over one file would account P × its mapped bytes.
+  void ReleaseViews() { UnmapAll(); }
 
   // Next buffer of whole records; false at end of shard. Builds into
   // *out in place so a pooled buffer keeps its capacity across chunks
@@ -772,14 +786,36 @@ class ShardReaderBase {
     if (fp_) { fclose(fp_); fp_ = nullptr; }
   }
 
-  // lazily map file i read-only; nullptr (and a sticky failure flag)
-  // when the file is not a mappable regular file of the promised size
-  // (e.g. shrank since listing — buffered mode detects that as a
-  // short read instead of SIGBUSing through a mapping)
-  const char* MapFile(int i) {
-    if (maps_.empty()) maps_.assign(files_.size(), nullptr);
-    if (maps_[(size_t)i]) return (const char*)maps_[(size_t)i];
-    size_t len = (size_t)(prefix_[i + 1] - prefix_[i]);
+  // lazily map the SHARD'S SLICE of file i read-only (page-aligned;
+  // middle files of a multi-file shard map whole). Mapping only the
+  // slice matters beyond tidiness: kernels that charge a whole mapping
+  // to RSS at its first touch (gVisor-class) would otherwise account
+  // nparsers × file_size for a gang splitting one file. Returns
+  // nullptr (and a sticky failure flag) when the file is not a
+  // mappable regular file of the promised size (e.g. shrank since
+  // listing — buffered mode detects that as a short read instead of
+  // SIGBUSing through a mapping). *map_lo receives the slice's start
+  // offset within the file.
+  const char* MapFile(int i, int64_t* map_lo) {
+    if (maps_.empty()) maps_.resize(files_.size());
+    MapEntry& e = maps_[(size_t)i];
+    if (e.ptr) {
+      *map_lo = e.lo;
+      return (const char*)e.ptr;
+    }
+    int64_t fsize = prefix_[i + 1] - prefix_[i];
+    int64_t lo = std::max<int64_t>(begin_ - prefix_[i], 0);
+    // mmap offsets must align to the REAL page size (16K/64K on some
+    // arm64 hosts; hardcoding 4096 would EINVAL there and stick the
+    // reader into buffered mode)
+    static const int64_t kPage =
+        std::max<int64_t>((int64_t)sysconf(_SC_PAGESIZE), 1);
+    lo -= lo % kPage;
+    int64_t hi = std::min<int64_t>(end_ - prefix_[i], fsize);
+    if (hi <= lo) {
+      mmap_failed_ = true;  // nothing of this file belongs to the shard
+      return nullptr;
+    }
     int fd = open(files_[(size_t)i].path.c_str(), O_RDONLY);
     if (fd < 0) {
       mmap_failed_ = true;
@@ -787,30 +823,38 @@ class ShardReaderBase {
     }
     struct stat st;
     if (fstat(fd, &st) != 0 || !S_ISREG(st.st_mode) ||
-        (size_t)st.st_size < len) {
+        st.st_size < hi) {
       close(fd);
       mmap_failed_ = true;
       return nullptr;
     }
-    void* m = mmap(nullptr, len, PROT_READ, MAP_PRIVATE, fd, 0);
+    size_t len = (size_t)(hi - lo);
+    void* m = mmap(nullptr, len, PROT_READ, MAP_PRIVATE, fd, (off_t)lo);
     close(fd);
     if (m == MAP_FAILED) {
       mmap_failed_ = true;
       return nullptr;
     }
     madvise(m, len, MADV_SEQUENTIAL);
-    maps_[(size_t)i] = m;
+    e.ptr = m;
+    e.lo = lo;
+    e.len = len;
+    *map_lo = lo;
     return (const char*)m;
   }
 
   void UnmapAll() {
-    for (size_t i = 0; i < maps_.size(); ++i)
-      if (maps_[i])
-        munmap(maps_[i], (size_t)(prefix_[i + 1] - prefix_[i]));
+    for (auto& e : maps_)
+      if (e.ptr) munmap(e.ptr, e.len);
     maps_.clear();
   }
 
-  std::vector<void*> maps_;
+  struct MapEntry {
+    void* ptr = nullptr;
+    int64_t lo = 0;
+    size_t len = 0;
+  };
+  std::vector<MapEntry> maps_;
   bool mmap_failed_ = false;
 
 
@@ -2296,7 +2340,34 @@ struct ParserHandle {
     stats.end_ns = now_ns();
     max_chunk_depth = chunks ? chunks->max_depth() : 0;
     max_reorder_depth = blocks ? blocks->max_depth() : 0;
+    TrimPools();
+    // all workers have exited (the ordered queue finished), so no chunk
+    // view is in flight: the file mappings can drop with the pools —
+    // CSR blocks handed out (or leased) are arena copies, never views
+    reader->ReleaseViews();
     return 0;
+  }
+
+  // End-of-stream pool trim. The per-parser free lists exist to recycle
+  // buffers BETWEEN CHUNKS of one run; holding them BETWEEN RUNS pins
+  // worst-case-reserved arenas per live parser for as long as the
+  // parser object exists — a gang holding P parsers retained P × ~2
+  // arenas ≈ 10× its text share (measured r6: 8 parsers over a 128 MB
+  // corpus pinned ~1.2 GB of pool slack) — while the warm-buffer job
+  // between runs already belongs to the bounded, process-global
+  // BlockCache. Dropping the pools at EOF routes each Buf's backing
+  // block into BlockCache (or frees it past the cache budget), so
+  // steady-state RSS tracks data actually retained, not pool slack.
+  void TrimPools() {
+    std::vector<std::unique_ptr<CSRArena>> drop_arenas;
+    std::vector<std::string> drop_chunks;
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      drop_arenas.swap(arena_pool);
+      drop_chunks.swap(chunk_pool);
+    }
+    // destructors run outside pool_mu: BlockCache::Put takes its own
+    // lock and a consumer thread may call Release concurrently
   }
 
   // the block most recently handed out by Next() (ABI pointer source);

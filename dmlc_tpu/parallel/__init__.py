@@ -7,10 +7,12 @@ each host's InputSplit shard feeds jax.make_array_from_process_local_data.
 
 from dmlc_tpu.parallel.device_iter import DeviceIter, device_prefetch
 from dmlc_tpu.parallel.sharded import (
-    ShardedRowBlockIter, make_global_batch, pad_to_bucket,
-    stack_device_batches, empty_block, next_pow2_bucket, ensure_schema,
+    ShardedRowBlockIter, make_global_batch, make_replicated,
+    pad_to_bucket, stack_device_batches, stack_padded_rows, empty_block,
+    next_pow2_bucket, ensure_schema,
 )
 
 __all__ = ["DeviceIter", "device_prefetch", "ShardedRowBlockIter",
-           "make_global_batch", "pad_to_bucket", "stack_device_batches",
-           "empty_block", "next_pow2_bucket", "ensure_schema"]
+           "make_global_batch", "make_replicated", "pad_to_bucket",
+           "stack_device_batches", "stack_padded_rows", "empty_block",
+           "next_pow2_bucket", "ensure_schema"]

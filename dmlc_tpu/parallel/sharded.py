@@ -36,8 +36,8 @@ from dmlc_tpu.utils.logging import (
 )
 
 __all__ = ["pad_to_bucket", "stack_device_batches", "make_global_batch",
-           "ShardedRowBlockIter", "next_pow2_bucket", "empty_block",
-           "ensure_schema"]
+           "make_replicated", "stack_padded_rows", "ShardedRowBlockIter",
+           "next_pow2_bucket", "empty_block", "ensure_schema"]
 
 
 def next_pow2_bucket(n: int, minimum: int = 8) -> int:
@@ -121,6 +121,60 @@ def stack_device_batches(batches: List[Dict[str, np.ndarray]]
     return {k: np.stack([np.asarray(b[k]) for b in batches]) for k in keys}
 
 
+def stack_padded_rows(blocks: List[RowBlock], row_bucket: int,
+                      nnz_bucket: int, want_qid: bool = False,
+                      want_field: bool = False) -> Dict[str, np.ndarray]:
+    """pad_to_bucket + ensure_schema + stack_device_batches fused into
+    ONE pass: the stacked [L, ...] arrays are allocated directly and
+    each device's slice written in place — no per-device intermediate
+    arrays, no np.stack copy. Byte-identical to the composed path
+    (pinned by test_fused_stack_matches_composed_path); this is the
+    serve-thread hot loop of steady replay, where every written byte is
+    throughput off the page tier, so it writes each element once
+    (data prefix + neutral-pad tail) instead of fill-then-overwrite."""
+    L = len(blocks)
+    check(L > 0, "no device batches")
+    has_qid = want_qid or any(b.qid is not None for b in blocks)
+    has_field = want_field or any(b.field is not None for b in blocks)
+    rb, nb = row_bucket, nnz_bucket
+    out = {
+        "offset": np.empty((L, rb + 1), np.int64),
+        "label": np.empty((L, rb), np.float32),
+        "weight": np.empty((L, rb), np.float32),
+        "index": np.empty((L, nb), blocks[0].index.dtype),
+        "value": np.empty((L, nb), np.float32),
+        "num_rows": np.empty(L, np.int32),
+        "num_nnz": np.empty(L, np.int32),
+    }
+    if has_qid:
+        out["qid"] = np.empty((L, rb), np.int64)
+    if has_field:
+        out["field"] = np.empty((L, nb), np.int64)
+    for i, b in enumerate(blocks):
+        n, nnz = b.size, b.nnz
+        check_le(n, rb, "row bucket too small")
+        check_le(nnz, nb, "nnz bucket too small")
+        out["offset"][i, :n + 1] = b.offset
+        out["offset"][i, n + 1:] = nnz
+        out["label"][i, :n] = b.label
+        out["label"][i, n:] = 0.0
+        out["weight"][i, :n] = b.weight if b.weight is not None else 1.0
+        out["weight"][i, n:] = 0.0
+        out["index"][i, :nnz] = b.index
+        out["index"][i, nnz:] = 0
+        out["value"][i, :nnz] = b.value if b.value is not None else 1.0
+        out["value"][i, nnz:] = 0.0
+        out["num_rows"][i] = n
+        out["num_nnz"][i] = nnz
+        if has_qid:
+            out["qid"][i, :n] = b.qid if b.qid is not None else -1
+            out["qid"][i, n:] = -1
+        if has_field:
+            out["field"][i, :nnz] = b.field if b.field is not None else 0
+            out["field"][i, nnz:] = 0
+    return out
+
+
 def make_global_batch(local: Dict[str, np.ndarray], mesh: Mesh,
                       axis: str = "data") -> Dict[str, jax.Array]:
     """Local stacked batch [local_devices, ...] → global jax.Arrays
@@ -139,6 +193,29 @@ def make_global_batch(local: Dict[str, np.ndarray], mesh: Mesh,
     return out
 
 
+def make_replicated(tree, mesh: Mesh):
+    """Host pytree → fully replicated global jax.Arrays on ``mesh``.
+
+    Built with make_array_from_single_device_arrays (each local device
+    gets a copy), NOT ``jax.device_put(x, replicated_sharding)``: for a
+    numpy input and a non-fully-addressable sharding, device_put runs a
+    cross-process assert_equal collective per leaf — a per-call tax on
+    real gangs, and outright unsupported on the multiprocess CPU
+    backend. Callers must pass value-identical trees on every process
+    (the usual seeded-init contract); nothing verifies it here.
+    """
+    import jax as _jax
+    sharding = NamedSharding(mesh, P())
+
+    def put(x):
+        x = np.asarray(x)
+        arrs = [_jax.device_put(x, d) for d in mesh.local_devices]
+        return _jax.make_array_from_single_device_arrays(
+            x.shape, sharding, arrs)
+
+    return _jax.tree_util.tree_map(put, tree)
+
+
 class ShardedRowBlockIter:
     """Device-granular sharded ingest: global device d reads part d.
 
@@ -152,16 +229,25 @@ class ShardedRowBlockIter:
     here num_parts = total devices and assembly is a jax.Array.
 
     Steady-epoch replay (reference: disk_row_iter.h's parse-once/
-    replay-epochs, taken all the way to memory): epochs after the first
-    serve retained stacked rounds — no parse, no pad, no stack, only
-    device transfers — whenever (a) ``steady_replay`` is on (default),
-    (b) the rounds fit ``agreement_cache_bytes``, and (c) a per-file
-    (size, mtime_ns) fingerprint still matches. On any mismatch the
-    epoch transparently re-parses with the replay-count mutation
-    assertions (truncation/rewrite raise DMLCError, appended bytes stay
-    invisible) and re-earns replay by teeing the clean re-parse. The
-    first epoch of a single-process "auto" run streams (fast first
-    batch); its epoch 2 re-parses and tees; epochs 3+ replay.
+    replay-epochs, composed in two tiers): epochs after the first serve
+    retained rounds of RAW (unpadded) blocks — padded, stacked and
+    transferred on the serve-prefetch thread — whenever (a)
+    ``steady_replay`` is on (default) and (b) a per-file
+    (size, mtime_ns, ctime_ns, inode) fingerprint still matches. The
+    tier is picked by budget: rounds whose raw bytes fit
+    ``agreement_cache_bytes`` stay in memory; larger rounds SPILL to a
+    fingerprint-stamped binary page file (``spill_dir``, DiskRowIter's
+    page format generalized to rounds) and steady epochs replay pages
+    at disk rate instead of re-parsing text every epoch (the
+    larger-than-RAM case, exactly where parse is most expensive).
+    ``replay_tier`` reports which tier served the last epoch
+    ("parse" | "memory" | "pages"); ``page_replay_epochs`` counts the
+    page-served ones. On any fingerprint mismatch the epoch
+    transparently re-parses with the replay-count mutation assertions
+    (truncation/rewrite raise DMLCError, appended bytes stay invisible)
+    and re-earns replay by teeing the clean re-parse. The first epoch
+    of a single-process "auto" run streams (fast first batch); its
+    epoch 2 re-parses and tees; epochs 3+ replay.
     """
 
     def __init__(self, uri: str, mesh: Mesh, format: Optional[str] = None,
@@ -169,7 +255,8 @@ class ShardedRowBlockIter:
                  nnz_bucket: int = 1 << 18, index_dtype=np.uint32,
                  agreement_cache_bytes: int = 1 << 30,
                  first_epoch_cache: str = "auto",
-                 steady_replay: bool = True, **parser_kwargs):
+                 steady_replay: bool = True, page_spill: bool = True,
+                 spill_dir: Optional[str] = None, **parser_kwargs):
         from dmlc_tpu.data.parser import Parser
         check(first_epoch_cache in ("auto", "always", "never"),
               "first_epoch_cache must be auto|always|never")
@@ -193,22 +280,38 @@ class ShardedRowBlockIter:
         # epoch 1 (first batch after one block parse, no cache RSS).
         # "always"/"never" force either path (tests, tuning).
         self.first_epoch_cache = first_epoch_cache
-        # Steady-epoch replay (VERDICT r4 #2): keep the epoch-1 batches
-        # as stacked [L, ...] rounds and serve later epochs from memory
-        # instead of re-parsing the text (config 8 measured page replay
-        # at 2-5x the parse rate; in-memory rounds skip even the page
-        # decode). Guarded by a per-file (size, mtime_ns) fingerprint
-        # captured before the cached parse: ANY mismatch falls back to
-        # the legacy re-parse epoch, whose count assertions implement
-        # the exact mutation semantics (truncation/rewrite raise,
-        # appends stay invisible) — replay is a pure optimization, never
-        # a semantics change. The retained rounds are written once and
-        # only read afterwards, so CPU-backend device_put aliasing
-        # (io/tpu_fs._device_put_safe) cannot corrupt served batches.
+        # Steady-epoch replay (VERDICT r4 #2, page tier r6): keep the
+        # epoch-1 rounds as RAW (unpadded) block rows and serve later
+        # epochs from them — padded/stacked/transferred on the serve-
+        # prefetch thread — instead of re-parsing the text. Rounds
+        # within agreement_cache_bytes of RAW bytes stay in memory
+        # (steady RSS ~ raw text size, not the several-x padded size
+        # the r5 tee retained); larger rounds spill to a binary page
+        # file and replay at page rate (config 8: 1.4-2.0 GB/s text-
+        # equivalent vs the 0.22 GB/s parse path). Guarded by a
+        # per-file fingerprint captured before the cached parse: ANY
+        # mismatch falls back to the legacy re-parse epoch, whose count
+        # assertions implement the exact mutation semantics
+        # (truncation/rewrite raise, appends stay invisible) — replay
+        # is a pure optimization, never a semantics change. Retained
+        # blocks are written once and only read afterwards, so CPU-
+        # backend device_put aliasing (io/tpu_fs._device_put_safe)
+        # cannot corrupt served batches (serve-time padding copies into
+        # fresh arrays every round anyway).
         self.steady_replay = steady_replay
-        self.replay_epochs = 0  # served-from-memory epochs (stats/tests)
-        self._round_cache: Optional[List[Dict[str, np.ndarray]]] = None
+        self.page_spill = page_spill
+        self._spill_dir = spill_dir
+        self.replay_epochs = 0        # replay-served epochs (all tiers)
+        self.page_replay_epochs = 0   # ... of which from the page tier
+        self.replay_tier: Optional[str] = None  # last epoch's server
+        self._round_store: Optional["ShardedRowBlockIter._RoundStore"] \
+            = None
         self._fingerprint = None
+        self._was_pages = False  # last dropped store was page-tier:
+        # its re-earn tee starts spilled (the shard is known over
+        # budget; memory accumulation would be redundant copying)
+        self._serve_queue = None  # live serve ThreadedIter (probes)
+        self._serve_stats: Optional[Dict[str, float]] = None
         # serve-side prefetch lookahead (rounds assembled ahead of the
         # consumer); dmlc_tpu.pipeline exposes it as an autotuner knob
         self.prefetch_depth = 2
@@ -287,72 +390,103 @@ class ShardedRowBlockIter:
             assert cached is not None
             self._part_rounds = [len(c) for c in cached]
             self._rounds_per_epoch = rounds
-            rb, nb = self.row_bucket, self.nnz_bucket
-            empty_padded = ensure_schema(
-                pad_to_bucket(empty_block(self.index_dtype), rb, nb),
-                rb, nb, self._has_qid, self._has_field)
-            tee = self._ReplayTee(
-                self.agreement_cache_bytes if self.steady_replay else 0,
-                fp)
+            empty = empty_block(self.index_dtype)
+            # the cache pass enforced the raw-byte budget, so this tee
+            # lands in the memory tier (it takes ownership of the
+            # cached blocks — no second copy); only the shared empty
+            # pads nudge its accounting past the cache pass's
+            tee = self._make_tee(fp, owned_rows=True)
 
             def assemble_round(r: int) -> Dict[str, jax.Array]:
-                row = []
+                row = [c[r] if r < len(c) else empty for c in cached]
+                # pad/stack at serve time (this runs on the prefetch
+                # producer thread): the counting pass stays pure parse
+                # and the retained rounds stay RAW
+                stacked = self._assemble_stacked(row)
                 for c in cached:
                     if r < len(c):
-                        row.append(ensure_schema(c[r], rb, nb,
-                                                 self._has_qid,
-                                                 self._has_field))
-                    else:
-                        row.append(empty_padded)
-                stacked = stack_device_batches(row)
-                for c in cached:
-                    if r < len(c):
-                        c[r] = None  # round-major owns the data now
-                tee.add(stacked)
+                        c[r] = None  # the tee owns the blocks now
+                tee.add_row(row)
                 return make_global_batch(stacked, self.mesh, self.axis)
 
-            # stack+assembly for round r+1 runs on a background thread
-            # while the consumer works on round r: claws back the
-            # parse/consume overlap that cache-then-replay serializes
-            # (steady epochs get it for free from streaming)
+            # pad+stack+assembly for round r+1 runs on a background
+            # thread while the consumer works on round r: claws back
+            # the parse/consume overlap that cache-then-replay
+            # serializes (steady epochs get it for free from streaming)
             rr = iter(range(rounds))
-            yield from self._prefetch_serve(
-                lambda: (assemble_round(r)
-                         if (r := next(rr, None)) is not None else None))
-            # commit the replay rounds only on a COMPLETE un-abandoned
-            # epoch whose files re-stat unchanged
-            tee.commit(self, rounds)
+            try:
+                yield from self._prefetch_serve(
+                    lambda: (assemble_round(r)
+                             if (r := next(rr, None)) is not None
+                             else None))
+                # commit the replay rounds only on a COMPLETE
+                # un-abandoned epoch whose files re-stat unchanged
+                tee.commit(self, rounds)
+            finally:
+                tee.close()
             return
         # some process exceeded its budget: EVERYONE runs the legacy
         # per-round agreement (skewed shards make a process exhaust
         # early; it must keep yielding empty batches until ALL are done
         # — batch count is a collective contract), counting rounds so
         # every later epoch skips the collective entirely. A local cache
-        # is dropped rather than replayed so both sides of the protocol
-        # stay identical.
+        # is dropped rather than used for assembly so both sides of the
+        # protocol stay identical — but the epoch is still TEED locally
+        # when this process wanted to cache: the tee is not part of the
+        # protocol (replay and re-parse produce the same global-batch
+        # call sequence), and an over-budget shard spills its rounds to
+        # pages here, earning page replay from epoch 2 on.
+        # force_spill when THIS rank's cache pass just measured the
+        # shard over budget (cached is None despite wanting to cache):
+        # re-accumulating up to the budget in memory a second time only
+        # to flush it to the writer would be pure redundant copying. A
+        # rank that cached fine but lost the vote keeps the memory tier.
+        over_budget = want_cache and cached is None
         cached = None
+        tee = (self._make_tee(fp, force_spill=over_budget) if want_cache
+               else self._ReplayTee(0, None, None))
         its, done, counts = self._restart_streams()
         rounds = 0
-        while True:
-            row = self._next_row(its, done, counts)
-            if self._all_processes_done(all(done)):
-                self._part_rounds = counts
-                self._rounds_per_epoch = rounds
-                return
-            rounds += 1
-            yield self._assemble(row)
+        try:
+            while True:
+                row = self._next_row(its, done, counts)
+                if self._all_processes_done(all(done)):
+                    self._part_rounds = counts
+                    self._rounds_per_epoch = rounds
+                    tee.commit(self, rounds)
+                    return
+                rounds += 1
+                tee.add_row(row)
+                yield self._assemble(row)
+        finally:
+            tee.close()
 
-    def _replay_rounds(self, stacked_rounds: List[Dict[str, np.ndarray]]
-                       ) -> Iterator[Dict[str, jax.Array]]:
-        """Serve an epoch from retained stacked rounds: zero parsing,
-        zero padding, zero host copies — only the device transfers,
-        prefetched one round ahead. No collectives (the replay path and
-        the re-parse path produce the same global-batch call sequence,
-        so ranks may mix paths when only SOME see a local mutation)."""
-        rr = iter(stacked_rounds)
-        yield from self._prefetch_serve(
-            lambda: (make_global_batch(s, self.mesh, self.axis)
-                     if (s := next(rr, None)) is not None else None))
+    def _replay_store(self, store: "ShardedRowBlockIter._RoundStore"
+                      ) -> Iterator[Dict[str, jax.Array]]:
+        """Serve an epoch from retained raw rounds (memory or pages):
+        zero parsing — the serve-prefetch thread pads, stacks and
+        enqueues transfers one round ahead of the consumer. One
+        producer on purpose: page decode and pad/stack are BOTH
+        memcpy-bound, so a second serve thread just thrashes small-core
+        hosts (measured −35% here); the page read already overlaps the
+        consumer's step through _prefetch_serve. No collectives (the
+        replay path and the re-parse path produce the same global-batch
+        call sequence, so ranks may mix paths when only SOME see a
+        local mutation — or sit in different tiers)."""
+        rows = store.iter_rows()
+
+        def make():
+            row = next(rows, None)
+            if row is None:
+                return None
+            return make_global_batch(self._assemble_stacked(row),
+                                     self.mesh, self.axis)
+
+        try:
+            yield from self._prefetch_serve(make)
+        finally:
+            if hasattr(rows, "close"):
+                rows.close()
 
     def _fingerprint_now(self):
         """(path, size, mtime_ns, ctime_ns, inode) per backing file, or
@@ -378,49 +512,218 @@ class ShardedRowBlockIter:
         except Exception:  # noqa: BLE001 — any non-stat-able backing
             return None
 
-    class _ReplayTee:
-        """Accumulate stacked rounds within the byte budget; commit only
-        a COMPLETE epoch whose backing files re-stat to the fingerprint
-        captured before the epoch's parse began (a file mutated DURING
-        the pass must not arm replay with half-old half-new rounds).
-        Shared by the epoch-1 fast path and the re-parse tee so the
-        budget/commit invariant lives in one place."""
+    class _RoundStore:
+        """Retained epoch-1 rounds, served on steady epochs. Rows are
+        RAW (unpadded) per-part blocks; padding happens at serve time
+        on the prefetch thread."""
 
-        def __init__(self, budget: int, fp):
+        tier = "?"
+
+        def iter_rows(self) -> Iterator[List[RowBlock]]:
+            raise NotImplementedError
+
+        def drop(self) -> None:
+            pass
+
+    class _MemoryRounds(_RoundStore):
+        tier = "memory"
+
+        def __init__(self, rows: List[List[RowBlock]], nbytes: int):
+            self.rows: Optional[List[List[RowBlock]]] = rows
+            self.nbytes = nbytes  # raw block bytes (soak tests pin RSS)
+
+        def iter_rows(self):
+            return iter(self.rows or [])
+
+        def drop(self):
+            self.rows = None
+
+    class _PageRounds(_RoundStore):
+        tier = "pages"
+
+        def __init__(self, spill_file):
+            self.file = spill_file  # dmlc_tpu.data.row_iter.RoundSpillFile
+
+        def iter_rows(self):
+            return self.file.iter_rows()
+
+        def drop(self):
+            self.file.delete()
+
+    class _ReplayTee:
+        """Accumulate raw rounds within the byte budget, SPILLING to a
+        binary page file when they exceed it; commit only a COMPLETE
+        epoch whose backing files re-stat to the fingerprint captured
+        before the epoch's parse began (a file mutated DURING the pass
+        must not arm replay with half-old half-new rounds). Shared by
+        the epoch-1 fast path, the epoch-1 legacy path, and the
+        re-parse tee so the budget/spill/commit invariant lives in one
+        place. ``owned_rows`` marks rows whose blocks the caller hands
+        over (the epoch-1 cache pass); otherwise blocks may be
+        ephemeral arena views and the memory tier copies them (the
+        spill writer serializes immediately, so it never copies).
+        ``start_spilled`` skips the doomed memory accumulation when a
+        size pre-check already proved the shard over budget."""
+
+        def __init__(self, budget: int, fp, spill_path: Optional[str],
+                     owned_rows: bool = False,
+                     start_spilled: bool = False):
             self.budget = budget
             self.fp = fp
-            self.rounds: Optional[List[Dict[str, np.ndarray]]] = \
-                [] if (fp is not None and budget > 0) else None
+            self.active = fp is not None and budget > 0
+            self.spill_path = spill_path
+            self.owned_rows = owned_rows
+            self.rows: List[List[RowBlock]] = []
             self.used = 0
+            self._writer = None
+            self._committed = False
+            # opened lazily at the first row (its width = nparts)
+            self._spill_on_first_row = start_spilled
+            if self.active and start_spilled and spill_path is None:
+                self.active = False
 
-        def add(self, stacked: Dict[str, np.ndarray]) -> None:
-            if self.rounds is None:
+        def _writer_for(self, nparts: int):
+            from dmlc_tpu.data.row_iter import RoundSpillWriter
+            meta = {"fingerprint": [list(e) for e in self.fp]
+                    if self.fp else None}
+            return RoundSpillWriter(self.spill_path, nparts, meta)
+
+        def add_row(self, blocks: List[RowBlock]) -> None:
+            if not self.active:
                 return
-            self.used += sum(int(v.nbytes) for v in stacked.values())
-            if self.used > self.budget:
-                self.rounds = None  # over budget: no replay this life
-            else:
-                self.rounds.append(stacked)
+            try:
+                self._add_row(blocks)
+            except Exception as e:  # noqa: BLE001 — a full/unwritable
+                # disk must degrade to "no replay", never kill the epoch
+                log_warning(f"ShardedRowBlockIter: replay spill failed "
+                            f"({e}); steady epochs will re-parse")
+                self._abandon()
+
+        def _add_row(self, blocks: List[RowBlock]) -> None:
+            if self._writer is None and self._spill_on_first_row:
+                self._writer = self._writer_for(len(blocks))
+                self._spill_on_first_row = False
+            if self._writer is not None:
+                self._writer.add_row(blocks)
+                return
+            row = (list(blocks) if self.owned_rows
+                   else [b.copy() for b in blocks])
+            self.used += sum(b.memory_cost_bytes() for b in row)
+            if self.used <= self.budget:
+                self.rows.append(row)
+                return
+            # over budget: move to the page tier (or abandon when
+            # spilling is off — the pre-r6 behavior)
+            if self.spill_path is None:
+                self._abandon()
+                return
+            self._writer = self._writer_for(len(blocks))
+            for r in self.rows:
+                self._writer.add_row(r)
+            self._writer.add_row(row)
+            self.rows = []
+
+        def _abandon(self) -> None:
+            self.active = False
+            self.rows = []
+            if self._writer is not None:
+                self._writer.abort()
+                self._writer = None
 
         def commit(self, it: "ShardedRowBlockIter",
                    expected_rounds: int) -> None:
-            if (self.rounds is not None
-                    and len(self.rounds) == expected_rounds
-                    and it._fingerprint_now() == self.fp):
-                it._round_cache = self.rounds
-                it._fingerprint = self.fp
+            if not self.active:
+                return
+            got = (self._writer.rounds if self._writer is not None
+                   else len(self.rows))
+            if got != expected_rounds or it._fingerprint_now() != self.fp:
+                self._abandon()
+                return
+            if self._writer is not None:
+                try:
+                    spill_file = self._writer.commit()
+                except Exception as e:  # noqa: BLE001 — same degrade-
+                    # to-no-replay contract as add_row: a commit-time
+                    # ENOSPC/unlink must not kill a COMPLETE epoch
+                    log_warning(
+                        f"ShardedRowBlockIter: replay spill commit "
+                        f"failed ({e}); steady epochs will re-parse")
+                    self._abandon()
+                    return
+                it._round_store = ShardedRowBlockIter._PageRounds(
+                    spill_file)
+                self._writer = None
+            else:
+                it._round_store = ShardedRowBlockIter._MemoryRounds(
+                    self.rows, self.used)
+                self.rows = []
+            it._fingerprint = self.fp
+            self._committed = True
+
+        def close(self) -> None:
+            """Abort an un-committed spill (abandoned epoch): the .tmp
+            must not linger as if it were a cache."""
+            if not self._committed:
+                self._abandon()
+
+    def _make_tee(self, fp, owned_rows: bool = False,
+                  force_spill: bool = False) -> "_ReplayTee":
+        """A two-tier tee for this iterator: memory within the budget,
+        page spill above it (when enabled), starting directly in spill
+        mode when the size pre-check — or the caller's stronger
+        evidence (``force_spill``: a cache pass that just measured the
+        shard over budget) — proves memory accumulation doomed."""
+        if not self.steady_replay:
+            return self._ReplayTee(0, None, None)
+        return self._ReplayTee(
+            self.agreement_cache_bytes, fp, self._spill_path(),
+            owned_rows=owned_rows,
+            start_spilled=(self.page_spill
+                           and (force_spill
+                                or not self._cache_precheck_ok())))
+
+    # itertools.count: next() is atomic in CPython, so concurrent tees
+    # from different threads can never derive the same spill path (a
+    # bare `seq[0] += 1` could, and two writers would then interleave
+    # into one .tmp)
+    import itertools as _itertools
+    _SPILL_SEQ = _itertools.count(1)
+
+    def _spill_path(self) -> Optional[str]:
+        """Unique per-instance spill file under spill_dir, keyed by the
+        shard identity (uri/parts/buckets) so the name is self-
+        describing; the fingerprint rides in the file header for
+        sweep_stale_spill. None disables the page tier."""
+        if not self.page_spill:
+            return None
+        import hashlib
+        from dmlc_tpu.data.row_iter import default_spill_dir
+        key = hashlib.sha256(repr(
+            (self._uri, self._total_parts, self._my_parts,
+             self.row_bucket, self.nnz_bucket,
+             str(self.index_dtype))).encode()).hexdigest()[:16]
+        import os
+        return os.path.join(
+            self._spill_dir or default_spill_dir(),
+            f"rounds-{key}-p{os.getpid()}-{next(self._SPILL_SEQ)}.pages")
 
     def _prefetch_serve(self, make_next) -> Iterator[Dict[str, jax.Array]]:
         """Serve batches from a background producer, one round ahead:
         assembly/transfer of round r+1 overlaps the consumer's work on
-        round r."""
+        round r. The live queue is exposed as ``_serve_queue`` while an
+        epoch runs (pipeline probes sample its occupancy — that is what
+        lets the autotuner drive the shard.prefetch knob) and its
+        producer stats land in ``_serve_stats`` at epoch end."""
         from dmlc_tpu.data.threaded_iter import ThreadedIter
         ti = ThreadedIter(max_capacity=self.prefetch_depth)
         ti.init(make_next)
+        self._serve_queue = ti
         try:
             while (batch := ti.next()) is not None:
                 yield batch
         finally:
+            self._serve_queue = None
+            self._serve_stats = ti.stats()
             ti.destroy()
 
     def _steady_stream(self) -> Iterator[List[RowBlock]]:
@@ -556,44 +859,46 @@ class ShardedRowBlockIter:
         self._schema_rounds += 1
         return row
 
-    def _try_cache_epoch(self) -> Optional[List[List[Dict[str, np.ndarray]]]]:
-        """Parse all local parts into cached PADDED batch dicts, or None
-        if the budget is exceeded (the fallback rewinds the parsers).
+    def _try_cache_epoch(self) -> Optional[List[List[RowBlock]]]:
+        """Parse all local parts into cached RAW owned blocks, or None
+        if the budget is exceeded (the fallback rewinds the parsers and
+        runs the legacy per-round protocol, whose tee then spills the
+        epoch's rounds to pages).
 
-        Caching the pad_to_bucket output rather than raw blocks does two
-        jobs at once: the pad copies into fresh arrays, so the cache
-        owns its memory even when the engine hands out zero-copy leases
-        (recycled on the parser's next()); and the pad work lands in the
-        counting pass, so the post-agreement replay is pure stack +
-        global assembly — epoch 1 costs barely more than a steady epoch
-        (bench_suite config 7 pins the ratio)."""
+        Caching raw blocks (r6) instead of the r5 pad_to_bucket output
+        shrinks the cache toward the data's true CSR bytes — several×
+        below the padded size on short-row corpora — so more shards fit
+        the same budget AND steady RSS tracks raw, not padded, size.
+        The copy() detaches each block from any zero-copy engine lease
+        (recycled on the parser's next()); padding moved to the serve-
+        prefetch thread, where it overlaps the consumer's step."""
         budget = self.agreement_cache_bytes
         if not self._cache_precheck_ok():
             return None
         used = 0
-        cached: List[List[Dict[str, np.ndarray]]] = []
+        cached: List[List[RowBlock]] = []
         for p in self._parsers:
             p.before_first()
-            part: List[Dict[str, np.ndarray]] = []
+            part: List[RowBlock] = []
             for blk in self._rechunk(p):
                 self._note_schema(blk.qid is not None,
                                   blk.field is not None)
-                padded = pad_to_bucket(blk, self.row_bucket,
-                                       self.nnz_bucket)
-                used += sum(int(v.nbytes) for v in padded.values())
+                blk = blk.copy()
+                used += blk.memory_cost_bytes()
                 if used > budget:
                     return None
-                part.append(padded)
+                part.append(blk)
             cached.append(part)
         return cached
 
     def _cache_precheck_ok(self) -> bool:
         """Cheap size pre-check: when the backing store is a plain local
-        file whose local share already exceeds the budget (padded output
-        is rarely smaller than its text), skip the doomed caching
-        attempt instead of parsing up to the budget only to throw it
-        away. Near-boundary shards can still abort mid-pass — bounded
-        waste the fallback re-parse accepts by design."""
+        file whose local share already exceeds the budget (raw CSR
+        blocks are rarely smaller than their text), skip the doomed
+        in-memory caching attempt instead of parsing up to the budget
+        only to throw it away — the replay tee then starts directly in
+        spill mode. Near-boundary shards can still abort mid-pass —
+        bounded waste the fallback re-parse accepts by design."""
         try:
             import os
             from dmlc_tpu.io.tpu_fs import local_path
@@ -666,7 +971,6 @@ class ShardedRowBlockIter:
 
     def _assemble_stacked(self, blocks: List[RowBlock]
                           ) -> Dict[str, np.ndarray]:
-        rb, nb = self.row_bucket, self.nnz_bucket
         # locally observed keys are sticky too: a round where every part
         # is an empty pad must still carry the keys earlier rounds did.
         # (Degenerate sources where qid/field first appears MID-file
@@ -676,10 +980,13 @@ class ShardedRowBlockIter:
         # tag every row.)
         self._note_schema(any(b.qid is not None for b in blocks),
                           any(b.field is not None for b in blocks))
-        return stack_device_batches(
-            [ensure_schema(pad_to_bucket(b, rb, nb), rb, nb,
-                           self._has_qid, self._has_field)
-             for b in blocks])
+        # the fused pad+stack: one in-place pass instead of per-part
+        # pad_to_bucket dicts + np.stack — on the replay serve thread
+        # this halves the memcpy per round, which IS the page-tier
+        # throughput cap
+        return stack_padded_rows(blocks, self.row_bucket,
+                                 self.nnz_bucket, self._has_qid,
+                                 self._has_field)
 
     def _assemble(self, blocks: List[RowBlock]) -> Dict[str, jax.Array]:
         return make_global_batch(self._assemble_stacked(blocks),
@@ -687,34 +994,75 @@ class ShardedRowBlockIter:
 
     def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
         if self._rounds_per_epoch is None:
+            self.replay_tier = "parse"
             yield from self._first_epoch_batches()
             return
         self._check_not_shrunk()
-        if self._round_cache is not None:
+        if self._round_store is not None:
+            import os
+            store_file = getattr(self._round_store, "file", None)
+            if (store_file is not None
+                    and not os.path.exists(store_file.path)):
+                # spill file vanished (external cleanup raced us): not a
+                # data hazard — degrade to the re-parse path below and
+                # let the tee re-earn a fresh store
+                self._round_store = None
+                self._fingerprint = None
+        if self._round_store is not None:
             if (self._fingerprint is not None
                     and self._fingerprint == self._fingerprint_now()):
                 self.replay_epochs += 1
-                yield from self._replay_rounds(self._round_cache)
+                self.replay_tier = self._round_store.tier
+                if self.replay_tier == "pages":
+                    self.page_replay_epochs += 1
+                yield from self._replay_store(self._round_store)
                 return
             # backing files changed (or stopped stat-ing) since the
-            # rounds were captured: the cache is stale. Drop it and
-            # re-parse — _steady_stream's count assertions then decide
-            # whether the change was a hazard (truncation/rewrite
-            # raises) or benign (appends are invisible by byte-range),
-            # exactly the pre-replay semantics.
-            self._round_cache = None
-            self._fingerprint = None
-        # Re-parse epoch; tee the stacked rounds into a fresh replay
-        # cache when enabled and plausibly within budget, so single-
-        # process "auto" jobs (no epoch-1 cache) replay from epoch 3 on
-        # and a mutated-then-stable file re-earns replay after one clean
-        # re-parse epoch.
-        want_tee = (self.steady_replay and self._cache_precheck_ok())
-        tee = self._ReplayTee(
-            self.agreement_cache_bytes if want_tee else 0,
-            self._fingerprint_now() if want_tee else None)
-        for blocks in self._steady_stream():
-            stacked = self._assemble_stacked(blocks)
-            tee.add(stacked)
-            yield make_global_batch(stacked, self.mesh, self.axis)
-        tee.commit(self, self._rounds_per_epoch)
+            # rounds were captured: the store is stale. Drop it (a page
+            # tier deletes its spill file) and re-parse —
+            # _steady_stream's count assertions then decide whether the
+            # change was a hazard (truncation/rewrite raises) or benign
+            # (appends are invisible by byte-range), exactly the
+            # pre-replay semantics.
+            store, self._round_store, self._fingerprint = \
+                self._round_store, None, None
+            self._was_pages = store.tier == "pages"
+            store.drop()
+        # Re-parse epoch; tee the raw rounds into a fresh replay store
+        # (memory within budget, pages above it) so single-process
+        # "auto" jobs (no epoch-1 cache) replay from epoch 3 on and a
+        # mutated-then-stable file re-earns replay after one clean
+        # re-parse epoch. A shard whose previous store was pages is
+        # known over budget — skip the doomed memory accumulation.
+        self.replay_tier = "parse"
+        tee = self._make_tee(self._fingerprint_now(),
+                             force_spill=self._was_pages)
+        try:
+            for blocks in self._steady_stream():
+                tee.add_row(blocks)
+                yield self._assemble(blocks)
+            tee.commit(self, self._rounds_per_epoch)
+        finally:
+            tee.close()
+
+    def close(self) -> None:
+        """Release the replay store (a page-tier store deletes its
+        spill file) and destroy the parsers. Safe to call twice; also
+        invoked from __del__ so an abandoned iterator cannot leak spill
+        files past process exit by accident."""
+        store, self._round_store = self._round_store, None
+        if store is not None:
+            store.drop()
+        for p in self._parsers:
+            if hasattr(p, "destroy"):
+                try:
+                    p.destroy()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+        self._parsers = []
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass

@@ -23,6 +23,13 @@ attributable):
 - Any trial whose next-epoch throughput drops below
   ``revert_tolerance`` × the best accepted throughput is reverted and
   the knob is frozen for ``cooldown`` epochs.
+- Stages that replay (cache/shard) stamp ``extra.replay_tier``
+  ("parse" | "memory" | "pages") into their snapshot; when the tier
+  serving an epoch CHANGES (e.g. a re-parse epoch after a mutation, or
+  the first page-replay epoch — regimes ~5× apart in throughput), the
+  pending trial is discarded (knob restored, no freeze) and the best-
+  throughput reference resets, so a knob is never credited or blamed
+  for a tier flip.
 
 Convergence: knob values are clamped to [lo, hi] and every accept/revert
 is recorded in ``report()`` — on a steady workload the tuner reaches a
@@ -77,6 +84,8 @@ class Autotuner:
         self._best_tp: Optional[float] = None
         self._pending: Optional[Dict[str, Any]] = None
         self._log: List[Dict[str, Any]] = []
+        self._tier_sig: Optional[tuple] = None  # last epoch's replay
+        # tiers per stage — a change resets the throughput reference
 
     # -- helpers
 
@@ -101,6 +110,16 @@ class Autotuner:
             if s.get("name") == name:
                 return s
         return None
+
+    @staticmethod
+    def _tier_signature(snapshot: Dict[str, Any]) -> tuple:
+        """(stage, replay_tier) pairs for every tier-stamped stage —
+        empty for pipelines without replaying stages, so the tier gate
+        below never fires for them."""
+        return tuple(
+            (s.get("name"), (s.get("extra") or {}).get("replay_tier"))
+            for s in snapshot.get("stages") or []
+            if (s.get("extra") or {}).get("replay_tier"))
 
     def _resolve_pending(self, tp: float) -> None:
         trial = self._pending
@@ -163,6 +182,22 @@ class Autotuner:
     def after_epoch(self, snapshot: Dict[str, Any]) -> None:
         """Feed one completed epoch's stats; may adjust one knob."""
         tp = self._throughput(snapshot)
+        sig = self._tier_signature(snapshot)
+        if self._tier_sig is not None and sig != self._tier_sig:
+            # the serving tier flipped under this epoch: throughput is
+            # a different regime (page replay vs parse differ ~5×), so
+            # neither judge the pending trial by it nor let it set the
+            # best-throughput reference
+            self._best_tp = None
+            if self._pending is not None:
+                trial = self._pending
+                self._pending = None
+                trial["knob"].set(trial["old"])
+                trial["outcome"] = "discarded (replay tier changed)"
+                trial["throughput"] = round(tp, 2)
+                self._log.append({k: v for k, v in trial.items()
+                                  if k != "knob"})
+        self._tier_sig = sig
         if self._pending is not None:
             self._resolve_pending(tp)
         elif self._best_tp is None or tp > self._best_tp:

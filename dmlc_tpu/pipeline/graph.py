@@ -192,8 +192,15 @@ class _ParseRunner(_RunnerBase):
 
 
 class _CacheRunner(_RunnerBase):
-    """parse + cache → DiskRowIter binary row pages (parse once at
-    build, replay pages every epoch)."""
+    """parse + cache → replayed epochs with no re-parse, the tier
+    picked by budget (the ShardedRowBlockIter steady-replay story at
+    the single-stream level): blocks whose raw bytes fit
+    ``memory_budget_bytes`` are retained owned in RAM; larger datasets
+    build a DiskRowIter binary page cache (parse once at build, replay
+    pages every epoch). An explicit ``path`` forces the page tier (the
+    pre-r6 contract); with ``path=None`` the page file is derived under
+    the spill dir, fingerprint-keyed so a changed source gets a fresh
+    cache, with a sidecar meta for sweep_stale_spill."""
 
     kind = "cache"
 
@@ -206,6 +213,8 @@ class _CacheRunner(_RunnerBase):
               "a map stage, or drop the cache")
         from dmlc_tpu.data.row_iter import DiskRowIter
         sp = source.params
+        self._source_uri = sp["uri"]
+        self._source_parts = sp["num_parts"]
         p = {k: v for k, v in parse.params.items() if v is not None}
         p.pop("prefetch_depth", None)
         fmt = p.pop("format", None)
@@ -217,21 +226,125 @@ class _CacheRunner(_RunnerBase):
             return Parser.create(sp["uri"], sp["part_index"],
                                  sp["num_parts"], format=fmt, **p)
 
-        self._it = DiskRowIter(make_parser, cache.params["path"],
+        path = cache.params["path"]
+        budget = cache.params.get("memory_budget_bytes")
+        if budget is None:  # not `or`: an explicit 0 must force pages
+            budget = 1 << 30
+        self._blocks: Optional[List] = None
+        self._it = None
+        self.tier = "pages"
+        sidecar = None
+        if path is None:
+            if self._try_memory(make_parser, budget):
+                self.tier = "memory"
+                return
+            path, sidecar = self._derived_page_path(
+                sp, fmt, cache.params["rows_per_page"])
+        self._it = DiskRowIter(make_parser, path,
                                rows_per_page=cache.params["rows_per_page"])
+        if sidecar is not None:
+            # sidecar AFTER the successful build: a failed build must
+            # not leave a meta file that nothing will ever pair with
+            # (sweep_stale_spill removes orphaned sidecars regardless)
+            import json as _json
+            with open(path + ".meta.json", "w") as f:
+                _json.dump(sidecar, f)
+
+    def _try_memory(self, make_parser, budget: int) -> bool:
+        """Drain the parser into owned raw blocks within the budget;
+        False (with nothing retained) when the dataset is larger — the
+        caller then builds the page tier from a fresh parser. A stat
+        pre-check skips the doomed drain outright when the source's
+        byte share already exceeds the budget (raw CSR is rarely
+        smaller than its text — the same reasoning as
+        ShardedRowBlockIter._cache_precheck_ok), so a 10 GB source
+        does not parse 1 GiB twice."""
+        if not self._memory_precheck_ok(budget):
+            return False
+        parser = make_parser()
+        blocks: List = []
+        used = 0
+        ok = True
+        parser.before_first()
+        while parser.next():
+            blk = parser.value()
+            if getattr(blk, "lease", None) is not None:
+                blk = blk.copy()  # own past the parser's next()
+            used += blk.memory_cost_bytes()
+            if used > budget:
+                ok = False
+                break
+            blocks.append(blk)
+        if hasattr(parser, "destroy"):
+            parser.destroy()
+        if ok:
+            self._blocks = blocks
+        return ok
+
+    def _memory_precheck_ok(self, budget: int) -> bool:
+        try:
+            from dmlc_tpu.io.input_split import list_split_files
+            total = sum(size for _, size in
+                        list_split_files(self._source_uri))
+            share = total // max(self._source_parts, 1)
+            return share <= budget
+        except Exception:  # noqa: BLE001 — non-stat-able: try the drain
+            return True
+
+    @staticmethod
+    def _derived_page_path(sp, fmt, rows_per_page: int):
+        """(page path, sidecar meta or None) — fingerprint-keyed so a
+        changed source derives a fresh cache file; the CALLER writes
+        the sidecar once the cache build succeeds."""
+        import hashlib
+
+        from dmlc_tpu.data.row_iter import default_spill_dir
+        fingerprint = None
+        try:
+            import os as _os
+
+            from dmlc_tpu.io.input_split import list_split_files
+            from dmlc_tpu.io.tpu_fs import local_path
+            fingerprint = []
+            for fpath, _size in list_split_files(sp["uri"]):
+                st = _os.stat(local_path(fpath))
+                fingerprint.append([fpath, st.st_size, st.st_mtime_ns])
+        except Exception:  # noqa: BLE001 — non-stat-able source
+            fingerprint = None
+        key = hashlib.sha256(repr(
+            (sp["uri"], sp["part_index"], sp["num_parts"], fmt,
+             rows_per_page, fingerprint)).encode()).hexdigest()[:16]
+        import os as _os
+        d = default_spill_dir()
+        _os.makedirs(d, exist_ok=True)
+        path = _os.path.join(d, f"cache-{key}.pages")
+        sidecar = ({"fingerprint": fingerprint}
+                   if fingerprint is not None else None)
+        return path, sidecar
 
     @property
     def queue(self):
         return getattr(self._it, "_iter", None)
 
     def epoch(self) -> Iterator:
+        if self._blocks is not None:
+            yield from self._blocks
+            return
         it = self._it
         it.before_first()
         while it.next():
             yield it.value()
 
+    def finalize_epoch(self) -> None:
+        # which replay tier served the epoch — the autotuner must not
+        # judge a knob trial across a tier flip, and bench JSON readers
+        # need to know which regime a number came from
+        self.probe.extra["replay_tier"] = self.tier
+
     def close(self) -> None:
-        self._it._close()
+        self._blocks = None
+        if self._it is not None:
+            self._it._close()
 
 
 class _ShardRunner(_RunnerBase):
@@ -259,6 +372,14 @@ class _ShardRunner(_RunnerBase):
     def epoch(self) -> Iterator:
         return iter(self._it)
 
+    @property
+    def queue(self):
+        # the live serve ThreadedIter while an epoch runs: occupancy
+        # samples land in the probe, which is what lets the autotuner
+        # actually drive the shard.prefetch knob (before r6 the shard
+        # stage had no queue telemetry, so the knob never moved)
+        return getattr(self._it, "_serve_queue", None)
+
     def knobs(self) -> List[Knob]:
         it = self._it
 
@@ -267,6 +388,23 @@ class _ShardRunner(_RunnerBase):
 
         return [Knob("shard.prefetch", "shard",
                      lambda: it.prefetch_depth, _set, lo=1, hi=8)]
+
+    def finalize_epoch(self) -> None:
+        it = self._it
+        tier = getattr(it, "replay_tier", None)
+        if tier is not None:
+            self.probe.extra["replay_tier"] = tier
+        self.probe.extra["replay_epochs"] = getattr(it, "replay_epochs", 0)
+        self.probe.extra["page_replay_epochs"] = getattr(
+            it, "page_replay_epochs", 0)
+        serve = getattr(it, "_serve_stats", None)
+        if serve:
+            self.probe.extra["serve"] = dict(serve)
+
+    def close(self) -> None:
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
 
 
 class _BatchRunner(_RunnerBase):
@@ -391,6 +529,13 @@ class _PrefetchRunner(_RunnerBase):
         return [Knob("prefetch.depth", "prefetch",
                      lambda: self._ti.capacity, self._ti.set_capacity,
                      lo=1, hi=64)]
+
+    def finalize_epoch(self) -> None:
+        if self._started:
+            # epoch-scoped producer counters (reset on before_first):
+            # blocked-on-full-queue time tells consumer-bound from
+            # producer-bound without inferring it from occupancy alone
+            self.probe.extra["producer"] = self._ti.stats()
 
     def close(self) -> None:
         self._ti.destroy()
@@ -617,11 +762,23 @@ class Pipeline:
                                     num_shuffle_parts=num_shuffle_parts,
                                     seed=seed))
 
-    def cache(self, path: str, rows_per_page: int = 64 << 10) -> "Pipeline":
-        """Parse once → binary row pages at ``path``; later epochs
-        replay pages (DiskRowIter) instead of re-parsing text."""
+    def cache(self, path: Optional[str] = None,
+              rows_per_page: int = 64 << 10,
+              memory_budget_bytes: Optional[int] = None) -> "Pipeline":
+        """Parse once; later epochs replay instead of re-parsing text.
+        The tier is picked by budget (default 1 GiB; an explicit 0
+        forces pages): raw blocks within ``memory_budget_bytes`` are
+        retained in RAM, larger datasets spill to binary row pages
+        (DiskRowIter) under the spill dir, fingerprint-keyed. An
+        explicit ``path`` forces the page tier at that location.
+
+        The memory tier serves the SAME RowBlock objects every epoch —
+        RowBlock is immutable by contract, so downstream ``map`` fns
+        must not mutate blocks in place (true of every stage, but here
+        a violation corrupts all later epochs instead of one)."""
         return self._with(StageSpec("cache", path=path,
-                                    rows_per_page=rows_per_page))
+                                    rows_per_page=rows_per_page,
+                                    memory_budget_bytes=memory_budget_bytes))
 
     def batch(self, rows: int, drop_remainder: bool = False) -> "Pipeline":
         """Re-chunk the block stream to exactly ``rows`` rows per block

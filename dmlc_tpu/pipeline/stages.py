@@ -14,8 +14,10 @@ Stage catalog (docs/pipeline.md has the narrative version):
   shuffle   — chunk-level shuffled read order (InputSplitShuffle;
               python engine, reference: input_split_shuffle.h)
   parse     — text/columnar bytes → CSR RowBlock stream (Parser.create)
-  cache     — parse once → binary row pages, replay later epochs
-              (DiskRowIter page cache)
+  cache     — parse once, replay later epochs; the tier is picked by
+              memory_budget_bytes: raw blocks in RAM when they fit,
+              a DiskRowIter binary page cache when they don't
+              (an explicit path forces pages)
   batch     — re-chunk the block stream to fixed row counts
   map       — user fn over each item
   prefetch  — bounded background queue (ThreadedIter); depth "auto" is

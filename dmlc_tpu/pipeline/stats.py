@@ -16,6 +16,16 @@ the downstream consumer asks the stage for an item it records
 ``snapshot()`` freezes one epoch of probes into a plain-JSON dict with a
 versioned schema (``PIPELINE_STATS_SCHEMA``) — the shape bench.py emits
 into BENCH JSON and tests/test_pipeline.py pins.
+
+Stage-specific ``extra`` fields (additive, schema version unchanged):
+
+- parse: ``bytes_read``, ``engine`` (native engine stats)
+- to_device: ``xfer_wait_s`` (transfer-drain wait)
+- cache / shard (r6): ``replay_tier`` — which tier served the epoch
+  ("parse" | "memory" | "pages"); shard also carries ``replay_epochs``
+  / ``page_replay_epochs`` counters and ``serve`` (the serve-prefetch
+  queue's producer stats: items produced, seconds blocked on a full
+  queue). The autotuner keys its tier-flip gate off ``replay_tier``.
 """
 
 from __future__ import annotations

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Repo lint gate (SURVEY §4's scripts/lint.py analogue).
+
+Two layers, so the gate degrades instead of disappearing on hosts
+without the tools baked in:
+
+- **Built-in checks** (always run, stdlib only): every tracked .py file
+  must parse (ast), use spaces-only indentation, carry no trailing
+  whitespace, no CR line endings, and end with exactly one newline.
+- **ruff** over the Python tree and **clang-format --dry-run -Werror**
+  over native/src/ — run when the binaries are importable/installed,
+  reported as skipped otherwise.
+
+Wired into the pytest suite via tests/test_lint.py, so tier-1 fails on
+a lint regression. CLI: ``python scripts/lint.py`` exits 0 clean / 1
+with findings on stderr.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SKIP_DIRS = {".git", ".claude", "__pycache__", ".pytest_cache", "build"}
+NATIVE_SRC = os.path.join(REPO, "dmlc_tpu", "native", "src")
+
+
+def python_files(root: str = REPO) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for name in filenames:
+            if name.endswith(".py"):
+                out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def native_files(root: str = NATIVE_SRC) -> List[str]:
+    if not os.path.isdir(root):
+        return []
+    return sorted(os.path.join(root, n) for n in os.listdir(root)
+                  if n.endswith((".cc", ".h", ".cpp", ".hpp")))
+
+
+def builtin_lint(paths: List[str]) -> List[str]:
+    """Stdlib-only findings: ["path:line: message"]."""
+    findings: List[str] = []
+    for path in paths:
+        rel = os.path.relpath(path, REPO)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            findings.append(f"{rel}:0: unreadable ({e})")
+            continue
+        if b"\r" in raw:
+            findings.append(f"{rel}:0: CR line endings")
+        try:
+            text = raw.decode("utf-8")
+        except UnicodeDecodeError as e:
+            findings.append(f"{rel}:0: not UTF-8 ({e})")
+            continue
+        try:
+            ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            findings.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        if text and not text.endswith("\n"):
+            findings.append(f"{rel}:0: missing trailing newline")
+        if text.endswith("\n\n"):
+            findings.append(f"{rel}:0: trailing blank lines at EOF")
+        for i, line in enumerate(text.split("\n"), 1):
+            stripped = line.rstrip("\n")
+            if stripped != stripped.rstrip():
+                findings.append(f"{rel}:{i}: trailing whitespace")
+            indent = stripped[:len(stripped) - len(stripped.lstrip())]
+            if "\t" in indent:
+                findings.append(f"{rel}:{i}: tab in indentation")
+    return findings
+
+
+def run_ruff(root: str = REPO) -> Optional[List[str]]:
+    """ruff findings, or None when ruff is not installed."""
+    cmd = None
+    try:
+        import ruff  # noqa: F401 — presence probe only
+        cmd = [sys.executable, "-m", "ruff"]
+    except ImportError:
+        from shutil import which
+        if which("ruff"):
+            cmd = ["ruff"]
+    if cmd is None:
+        return None
+    proc = subprocess.run(
+        cmd + ["check", "--quiet", root],
+        capture_output=True, text=True, timeout=300)
+    if proc.returncode == 0:
+        return []
+    out = (proc.stdout + proc.stderr).strip()
+    return [line for line in out.splitlines() if line.strip()]
+
+
+def run_clang_format(root: str = NATIVE_SRC) -> Optional[List[str]]:
+    """clang-format dry-run findings, or None when unavailable."""
+    from shutil import which
+    if which("clang-format") is None:
+        return None
+    files = native_files(root)
+    if not files:
+        return []
+    proc = subprocess.run(
+        ["clang-format", "--dry-run", "-Werror"] + files,
+        capture_output=True, text=True, timeout=300)
+    if proc.returncode == 0:
+        return []
+    return [line for line in proc.stderr.splitlines() if line.strip()]
+
+
+def main() -> int:
+    findings = builtin_lint(python_files())
+    ruff = run_ruff()
+    if ruff is None:
+        print("lint: ruff not installed — built-in checks only",
+              file=sys.stderr)
+    else:
+        findings += ruff
+    cf = run_clang_format()
+    if cf is None:
+        print("lint: clang-format not installed — native/src unchecked",
+              file=sys.stderr)
+    else:
+        findings += cf
+    for f in findings:
+        print(f, file=sys.stderr)
+    print(f"lint: {len(findings)} finding(s) over "
+          f"{len(python_files())} python files", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

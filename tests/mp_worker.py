@@ -34,26 +34,35 @@ def main() -> int:
     data_uri, out_dir, phase = sys.argv[1], sys.argv[2], sys.argv[3]
     import jax
     import numpy as np
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
 
     from dmlc_tpu.io.checkpoint import ShardedCheckpoint
     from dmlc_tpu.models.linear import SparseLinearModel
     from dmlc_tpu.parallel.launch import init_from_env, finalize
-    from dmlc_tpu.parallel.sharded import ShardedRowBlockIter
+    from dmlc_tpu.parallel.sharded import (
+        ShardedRowBlockIter, make_replicated,
+    )
 
     pid, nprocs = init_from_env()
     assert jax.process_count() == nprocs, (jax.process_count(), nprocs)
     mesh = Mesh(np.array(jax.devices()), ("data",))
 
     model = SparseLinearModel(num_features=NUM_FEATURES, learning_rate=0.5)
-    replicated = NamedSharding(mesh, P())
-    params = jax.device_put(model.init_params(), replicated)
+    # make_replicated, not device_put-to-global-sharding: the latter
+    # runs an assert_equal collective per leaf (and cannot run at all
+    # on the multiprocess CPU backend)
+    params = make_replicated(model.init_params(), mesh)
     step_fn = model.make_sharded_train_step(mesh)
     # DMLC_TEST_CACHE_BYTES_RANK0: force THIS rank over/under the
     # epoch-1 cache budget to exercise the mixed-vote path — one rank
     # over budget must vote EVERY rank onto the legacy per-round
-    # protocol (protocols may never mix across ranks)
+    # protocol (protocols may never mix across ranks).
+    # DMLC_TEST_CACHE_BYTES_ALL: force EVERY rank's budget (the r6
+    # page-spill gang test sets it tiny-but-positive so steady epochs
+    # must serve from spilled round pages on all ranks).
     cache_bytes = 1 << 30
+    if os.environ.get("DMLC_TEST_CACHE_BYTES_ALL"):
+        cache_bytes = int(os.environ["DMLC_TEST_CACHE_BYTES_ALL"])
     if pid == 0 and os.environ.get("DMLC_TEST_CACHE_BYTES_RANK0"):
         cache_bytes = int(os.environ["DMLC_TEST_CACHE_BYTES_RANK0"])
     it = ShardedRowBlockIter(data_uri, mesh, format="libsvm",
@@ -116,6 +125,8 @@ def main() -> int:
                   "epoch_collectives": epoch_collectives,
                   "epoch_digests": epoch_digests,
                   "replay_epochs": it.replay_epochs,
+                  "page_replay_epochs": it.page_replay_epochs,
+                  "replay_tier": it.replay_tier,
                   "w_head": np.asarray(params["w"])[:8].tolist()}
     elif phase == "restore":
         restored, user = ck.restore(like=params)

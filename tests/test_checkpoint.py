@@ -126,6 +126,46 @@ class TestShardedCheckpoint:
         flat, _ = ck.restore()
         np.testing.assert_array_equal(flat["x"], np.arange(64.0))
 
+    def test_restore_sweeps_stale_spill_cache(self, tmp_path, rng):
+        # restore marks a resume boundary: a replay spill file whose
+        # source fingerprint no longer stats clean must be gone after
+        # restore (the steady-replay mutation contract), while a cache
+        # of an unchanged source survives
+        from dmlc_tpu.data.row_iter import (
+            RoundSpillWriter, default_spill_dir,
+        )
+        from dmlc_tpu.data.rowblock import RowBlockContainer
+        src = tmp_path / "src.libsvm"
+        src.write_bytes(b"1 1:1.0\n")
+        st = os.stat(src)
+        d = default_spill_dir()
+        c = RowBlockContainer(np.uint32)
+        c.push(1.0, [1], [1.0])
+        blk = c.get_block()
+        uniq = os.path.basename(str(tmp_path)).replace("_", "")
+        stale = os.path.join(d, f"test-{uniq}-stale.pages")
+        fresh = os.path.join(d, f"test-{uniq}-fresh.pages")
+        for path, fp in (
+                (stale, [[str(src), st.st_size + 1, st.st_mtime_ns]]),
+                (fresh, [[str(src), st.st_size, st.st_mtime_ns]])):
+            w = RoundSpillWriter(path, nparts=1,
+                                 meta={"fingerprint": fp})
+            w.add_row([blk])
+            w.commit()
+        try:
+            tree, _ = self.make_sharded_tree()
+            ck = ShardedCheckpoint(str(tmp_path / "r"))
+            ck.save(1, tree)
+            ck.restore(like=tree)
+            assert not os.path.exists(stale), \
+                "restore must sweep fingerprint-stale spill caches"
+            assert os.path.exists(fresh), \
+                "restore must keep caches of unchanged sources"
+        finally:
+            for p in (stale, fresh):
+                if os.path.exists(p):
+                    os.remove(p)
+
 
 class TestCheckpointRegressions:
     def test_restore_without_like_replicated_and_scalar(self, tmp_path):

@@ -1,0 +1,48 @@
+"""Lint gate wired into tier-1 (SURVEY §4's scripts/lint.py analogue):
+the suite fails on a lint regression, with or without the optional
+external tools installed."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+import lint  # noqa: E402
+
+
+class TestLintGate:
+    def test_builtin_python_lint_clean(self):
+        findings = lint.builtin_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_ruff_clean_when_available(self):
+        findings = lint.run_ruff()
+        if findings is None:
+            pytest.skip("ruff not installed on this host")
+        assert findings == [], "\n".join(findings)
+
+    def test_clang_format_clean_when_available(self):
+        findings = lint.run_clang_format()
+        if findings is None:
+            pytest.skip("clang-format not installed on this host")
+        assert findings == [], "\n".join(findings)
+
+    def test_builtin_catches_planted_violations(self, tmp_path):
+        # the gate must actually bite: a tab-indented, trailing-space,
+        # newline-less file yields one finding per violation class
+        bad = tmp_path / "bad.py"
+        bad.write_bytes(b"def f():\n\treturn 1 \nx = f()")
+        findings = lint.builtin_lint([str(bad)])
+        kinds = "\n".join(findings)
+        assert "tab in indentation" in kinds
+        assert "trailing whitespace" in kinds
+        assert "missing trailing newline" in kinds
+
+    def test_builtin_catches_syntax_error(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n    pass\n")
+        findings = lint.builtin_lint([str(bad)])
+        assert any("syntax error" in f for f in findings)

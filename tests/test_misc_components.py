@@ -100,8 +100,15 @@ class TestBenchTransferProbe:
         assert bt.cell_single(dev, 1, 2, 4) > 0
         assert bt.cell_threads(dev, 2, 1, 1, 4) > 0
         assert bt.cell_mono(dev, 2) > 0
-        share = bt.enqueue_cpu_share(dev, chunk_mb=1, total_mb=2)
-        assert 0.0 <= share <= 2.0
+        # enqueue_cpu_share is process_time/wall: process-WIDE CPU, so
+        # inside a full-suite run (XLA pools + spin-waiting helpers) it
+        # legitimately exceeds the old quiet-process bound of 2.0 over
+        # a tiny wall window. The principled ceiling is the live thread
+        # count (process CPU rate cannot exceed it, modulo clock
+        # granularity — hence the slightly larger window and +2 slack).
+        import threading
+        share = bt.enqueue_cpu_share(dev, chunk_mb=1, total_mb=8)
+        assert 0.0 <= share <= threading.active_count() + 2, share
         rate, copied = bt.cell_under_cpu_load(dev, 1, 1, 2)
         assert rate > 0 and copied >= 0
 

@@ -1,6 +1,8 @@
 """Sharded ingest + CSR device ops + model training on the 8-device
 CPU mesh (the multi-chip contract, SURVEY.md §5.8)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -17,7 +19,8 @@ from dmlc_tpu.ops import (
 )
 from dmlc_tpu.parallel import (
     DeviceIter, ShardedRowBlockIter, device_prefetch, empty_block,
-    make_global_batch, next_pow2_bucket, pad_to_bucket, stack_device_batches,
+    ensure_schema, make_global_batch, next_pow2_bucket, pad_to_bucket,
+    stack_device_batches,
 )
 
 
@@ -108,6 +111,55 @@ class TestPadAndStack:
         stacked = stack_device_batches(blocks)
         assert stacked["label"].shape == (3, 8)
         assert stacked["num_rows"].shape == (3,)
+
+    def test_fused_stack_matches_composed_path(self, rng):
+        # stack_padded_rows is the replay serve-thread hot loop: it must
+        # be BYTE-identical to pad_to_bucket + ensure_schema +
+        # stack_device_batches on every column combination (plain,
+        # qid-bearing, field-bearing, weighted, empty pads, forced keys)
+        from dmlc_tpu.parallel.sharded import stack_padded_rows
+
+        def qid_block(rows):
+            c = RowBlockContainer(np.uint32)
+            for i in range(rows):
+                nnz = rng.randint(1, 5)
+                idx = np.sort(rng.choice(50, nnz, replace=False))
+                c.push(float(i % 3), idx, rng.rand(nnz), qid=i // 2,
+                       weight=0.5 + rng.rand())
+            return c.get_block()
+
+        def field_block(rows):
+            c = RowBlockContainer(np.uint32)
+            for i in range(rows):
+                nnz = rng.randint(1, 5)
+                idx = np.sort(rng.choice(50, nnz, replace=False))
+                c.push(float(i % 2), idx, rng.rand(nnz),
+                       fields=rng.randint(0, 4, nnz))
+            return c.get_block()
+
+        cases = [
+            ([random_block(rng, rows=5), random_block(rng, rows=3),
+              empty_block()], False, False),
+            ([qid_block(4), empty_block(), random_block(rng, rows=2)],
+             True, False),
+            ([field_block(3), empty_block()], False, True),
+            ([random_block(rng, rows=2)], True, True),  # forced keys
+        ]
+        for blocks, want_qid, want_field in cases:
+            fused = stack_padded_rows(blocks, 8, 64, want_qid, want_field)
+            composed = stack_device_batches(
+                [ensure_schema(pad_to_bucket(b, 8, 64), 8, 64,
+                               want_qid
+                               or any(x.qid is not None for x in blocks),
+                               want_field
+                               or any(x.field is not None
+                                      for x in blocks))
+                 for b in blocks])
+            assert set(fused) == set(composed)
+            for k in fused:
+                assert fused[k].dtype == composed[k].dtype, k
+                np.testing.assert_array_equal(fused[k], composed[k],
+                                              err_msg=k)
 
 
 class TestGlobalBatch:
@@ -307,6 +359,140 @@ class TestShardedRowBlockIter:
         for a, b in zip(e1, e3):
             for k in a:
                 np.testing.assert_array_equal(a[k], b[k])
+
+    @staticmethod
+    def _epoch_hash(batches):
+        """Content hash of one epoch's batch stream (order- and
+        key-sensitive) — the byte-parity probe for replay tiers."""
+        import hashlib
+        h = hashlib.sha256()
+        for gb in batches:
+            for k in sorted(gb):
+                h.update(k.encode())
+                h.update(np.ascontiguousarray(gb[k]).tobytes())
+        return h.hexdigest()
+
+    def test_page_spill_serves_steady_epochs_byte_identical(
+            self, mesh, tmp_path, rng):
+        # ISSUE 2 tentpole: an 8-device gang whose rounds exceed a
+        # deliberately tiny agreement_cache_bytes must SPILL the rounds
+        # to the binary page cache instead of abandoning replay, and
+        # every steady epoch must serve from pages with batches
+        # content-hash-identical to epoch 1
+        p = tmp_path / "d.libsvm"
+        self._write_libsvm(p, rng, 300)
+        it = ShardedRowBlockIter(str(p), mesh, format="libsvm",
+                                 row_bucket=32, nnz_bucket=64,
+                                 prefetch=False,
+                                 agreement_cache_bytes=2048,  # << shard
+                                 spill_dir=str(tmp_path / "spill"),
+                                 first_epoch_cache="always")
+        e1 = self._collect(it)
+        assert it.replay_tier == "parse"
+        assert it._round_store is not None
+        assert it._round_store.tier == "pages"
+        spill_path = it._round_store.file.path
+        assert os.path.exists(spill_path)
+        e2 = self._collect(it)
+        assert it.replay_tier == "pages"
+        assert (it.replay_epochs, it.page_replay_epochs) == (1, 1)
+        e3 = self._collect(it)
+        assert (it.replay_epochs, it.page_replay_epochs) == (2, 2)
+        assert (self._epoch_hash(e1) == self._epoch_hash(e2)
+                == self._epoch_hash(e3))
+        it.close()
+        assert not os.path.exists(spill_path), \
+            "close() must delete the spill file"
+
+    def test_page_spill_mutation_reparses_then_reearns(self, mesh,
+                                                       tmp_path, rng):
+        # the mutation contract is tier-independent: a page-armed
+        # iterator must notice the stat change, fall back to one clean
+        # asserting re-parse epoch (appends stay invisible), and
+        # re-earn PAGE replay — never serve stale pages
+        p = tmp_path / "d.libsvm"
+        self._write_libsvm(p, rng, 300)
+        it = ShardedRowBlockIter(str(p), mesh, format="libsvm",
+                                 row_bucket=32, nnz_bucket=64,
+                                 prefetch=False,
+                                 agreement_cache_bytes=2048,
+                                 spill_dir=str(tmp_path / "spill"),
+                                 first_epoch_cache="always")
+        e1 = self._collect(it)
+        first_spill = it._round_store.file.path
+        with open(p, "ab") as f:
+            f.write(b"1 3:0.5\n" * 200)
+        e2 = self._collect(it)
+        assert it.replay_tier == "parse"      # stat change: re-parse
+        assert it.page_replay_epochs == 0
+        assert not os.path.exists(first_spill), \
+            "stale spill file must be dropped with its store"
+        e3 = self._collect(it)
+        assert it.replay_tier == "pages"      # stable again: re-earned
+        assert it.page_replay_epochs == 1
+        assert (self._epoch_hash(e1) == self._epoch_hash(e2)
+                == self._epoch_hash(e3))
+        it.close()
+
+    def test_page_spill_truncation_still_raises(self, mesh, tmp_path,
+                                                rng):
+        # page tier must not weaken the hazard detection: truncating
+        # the backing file under a page-armed iterator raises the
+        # mutation error on the fallback re-parse, same as r5
+        from dmlc_tpu.utils.logging import DMLCError
+        p = tmp_path / "d.libsvm"
+        self._write_libsvm(p, rng, 300)
+        it = ShardedRowBlockIter(str(p), mesh, format="libsvm",
+                                 row_bucket=8, nnz_bucket=16,
+                                 prefetch=False,
+                                 agreement_cache_bytes=2048,
+                                 spill_dir=str(tmp_path / "spill"),
+                                 first_epoch_cache="always")
+        assert len(self._collect(it)) > 0
+        assert it._round_store is not None
+        data = p.read_bytes()
+        cut = data.index(b"\n", len(data) // 4) + 1
+        p.write_bytes(data[:cut])
+        with pytest.raises(DMLCError, match="changed between epochs"):
+            self._collect(it)
+
+    def test_raw_rounds_beat_padded_on_short_rows(self, mesh, tmp_path,
+                                                  rng):
+        # the RSS model's multiplier: on a short-row corpus the raw
+        # retained rounds must sit WELL below the padded bytes the r5
+        # tee held (nnz_bucket sized for the worst row, short rows
+        # leave most of it as pad) — the reason the r6 tee retains raw
+        p = tmp_path / "short.libsvm"
+        self._write_libsvm(p, rng, 400)  # 1 feature per row
+        it = ShardedRowBlockIter(str(p), mesh, format="libsvm",
+                                 row_bucket=64, nnz_bucket=1 << 10,
+                                 prefetch=False,
+                                 first_epoch_cache="always")
+        e1 = self._collect(it)
+        store = it._round_store
+        assert store is not None and store.tier == "memory"
+        padded_bytes = sum(int(v.nbytes) for gb in e1
+                           for v in gb.values())
+        assert store.nbytes < padded_bytes / 4, (
+            store.nbytes, padded_bytes)
+
+    def test_page_spill_off_abandons_over_budget(self, mesh, tmp_path,
+                                                 rng):
+        # page_spill=False restores the pre-r6 behavior: over-budget
+        # rounds abandon replay and every epoch re-parses (identically)
+        p = tmp_path / "d.libsvm"
+        self._write_libsvm(p, rng, 200)
+        it = ShardedRowBlockIter(str(p), mesh, format="libsvm",
+                                 row_bucket=32, nnz_bucket=64,
+                                 prefetch=False,
+                                 agreement_cache_bytes=2048,
+                                 page_spill=False,
+                                 first_epoch_cache="always")
+        e1 = self._collect(it)
+        e2 = self._collect(it)
+        assert it.replay_epochs == 0
+        assert it._round_store is None
+        assert self._epoch_hash(e1) == self._epoch_hash(e2)
 
     def test_steady_replay_off_reparses_every_epoch(self, mesh, tmp_path,
                                                     rng):

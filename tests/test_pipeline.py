@@ -129,12 +129,41 @@ class TestFusionEquivalence:
     def test_cache_stage_replays_pages(self, tmp_path):
         uri = _write_libsvm(tmp_path)
         cache = str(tmp_path / "rows.pages")
+        # an explicit path forces the page tier (pre-r6 contract)
         built = (Pipeline.from_uri(uri).parse(format="libsvm")
                  .cache(cache).build())
         h1 = _drain_hash(built)
         assert h1 == _parser_hash(uri, "libsvm")
         assert os.path.exists(cache)
+        assert built.stats()["stages"][0]["extra"]["replay_tier"] \
+            == "pages"
         # epoch 2 replays the same pages
+        assert _drain_hash(built) == h1
+        built.close()
+
+    def test_cache_stage_memory_tier_by_budget(self, tmp_path):
+        # path=None + a fitting budget → blocks retained raw in RAM,
+        # same content as a direct parse, no page file involved
+        uri = _write_libsvm(tmp_path)
+        built = (Pipeline.from_uri(uri).parse(format="libsvm")
+                 .cache().build())
+        h1 = _drain_hash(built)
+        assert h1 == _parser_hash(uri, "libsvm")
+        assert built.stats()["stages"][0]["extra"]["replay_tier"] \
+            == "memory"
+        assert _drain_hash(built) == h1  # epoch 2 from memory
+        built.close()
+
+    def test_cache_stage_spills_over_budget(self, tmp_path):
+        # path=None + a tiny budget → the lowering falls through to the
+        # page tier at a derived fingerprint-keyed path, content intact
+        uri = _write_libsvm(tmp_path)
+        built = (Pipeline.from_uri(uri).parse(format="libsvm")
+                 .cache(memory_budget_bytes=1024).build())
+        h1 = _drain_hash(built)
+        assert h1 == _parser_hash(uri, "libsvm")
+        assert built.stats()["stages"][0]["extra"]["replay_tier"] \
+            == "pages"
         assert _drain_hash(built) == h1
         built.close()
 
@@ -285,6 +314,33 @@ class TestShardStage:
         assert snap["stages"][0]["kind"] == "shard"
         built.close()
 
+    def test_shard_probe_reports_replay_tier(self, tmp_path):
+        # the probe must say which tier served each epoch — that is
+        # what the autotuner's tier gate and BENCH JSON read — and the
+        # serve queue's occupancy must be sampled so shard.prefetch is
+        # actually tunable
+        import jax
+        from jax.sharding import Mesh
+        uri = _write_libsvm(tmp_path, rows=640)
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+        built = (Pipeline.from_uri(uri).parse(format="libsvm")
+                 .shard(mesh, row_bucket=128, nnz_bucket=1 << 12,
+                        first_epoch_cache="always")
+                 .build())
+        s1 = built.run_epoch()
+        ex1 = s1["stages"][0]["extra"]
+        assert ex1["replay_tier"] == "parse"
+        assert ex1["replay_epochs"] == 0
+        s2 = built.run_epoch()
+        ex2 = s2["stages"][0]["extra"]
+        assert ex2["replay_tier"] == "memory"
+        assert ex2["replay_epochs"] == 1
+        assert ex2["page_replay_epochs"] == 0
+        assert "produced" in ex2["serve"]
+        # the serve queue was sampled: occupancy telemetry exists
+        assert s2["stages"][0]["queue_cap"] is not None
+        built.close()
+
 
 class TestStatsSchema:
     STAGE_KEYS = {"name", "kind", "items", "rows", "nnz", "bytes",
@@ -373,6 +429,39 @@ class TestAutotuner:
         t = Autotuner([self._knob(store)])
         t.after_epoch(self._snap(occupancy=0.05, wait_frac=0.0))
         assert store["v"] == 8
+
+    def _tier_snap(self, occupancy, tier, bytes_=10 ** 9):
+        snap = self._snap(occupancy, bytes_=bytes_)
+        snap["stages"][0]["extra"] = {"replay_tier": tier}
+        return snap
+
+    def test_tier_flip_discards_pending_trial(self):
+        # a knob trial must never be judged across a replay-tier flip:
+        # page replay vs parse differ ~5x, so the trial epoch's
+        # throughput says nothing about the knob. The trial is
+        # discarded (knob restored, NO freeze) and the best-throughput
+        # reference resets.
+        store = {"v": 4}
+        t = Autotuner([self._knob(store)])
+        t.after_epoch(self._tier_snap(0.9, "parse"))
+        assert store["v"] == 8  # trial armed during the parse epoch
+        # the next epoch serves from pages with 5x the bytes/s — without
+        # the gate this would be 'accepted' on tier speedup alone
+        t.after_epoch(self._tier_snap(0.9, "pages", bytes_=5 * 10 ** 9))
+        assert store["v"] == 8  # re-armed fresh on the pages epoch...
+        d0 = t.report()["decisions"][0]
+        assert d0["outcome"] == "discarded (replay tier changed)"
+        assert d0["old"] == 4 and d0["new"] == 8
+        # ...and judged within the pages regime from then on
+        t.after_epoch(self._tier_snap(0.9, "pages", bytes_=5 * 10 ** 9))
+        assert t.report()["decisions"][1]["outcome"] == "accepted"
+
+    def test_same_tier_epochs_judge_normally(self):
+        store = {"v": 4}
+        t = Autotuner([self._knob(store)])
+        t.after_epoch(self._tier_snap(0.9, "memory"))
+        t.after_epoch(self._tier_snap(0.9, "memory", bytes_=2 * 10 ** 9))
+        assert t.report()["decisions"][0]["outcome"] == "accepted"
 
     def test_converges_on_synthetic_slow_stage(self, tmp_path):
         """Fast producer, slow consumer: the prefetch queue sits full,
