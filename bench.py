@@ -16,11 +16,16 @@ autotuner instead of a hard-coded constant. A short hand-wired
 reference run (DMLC_TPU_BENCH_HANDWIRED_EPOCHS, default 3) reports
 "handwired_gbps" alongside so pipeline overhead stays visible.
 
+CLI: ``python bench.py [--trace out.json]`` — with --trace the
+measurement epochs run under the dmlc_tpu.obs trace recorder and a
+Chrome/Perfetto trace-event JSON (per-stage pull spans, queue waits,
+transfer drains, native-engine counter tracks) lands at the given path.
+
 Prints exactly ONE JSON line: {"metric", "value", "unit",
 "vs_baseline", "best_epoch", "epochs", "bound", "parse_cpu_gbps_core",
 "sustained_gauge_ok", "gauge_ok_epochs", "gauge_ok_threshold",
 "epoch_gauges", "gauge_bands", "run_band", "replay_gbps", "replay",
-"replay_tier", "handwired_gbps", "pipeline"} —
+"replay_tier", "handwired_gbps", "pipeline", "metrics", "trace"} —
 "value" is the SUSTAINED rate (20%-trimmed mean of per-epoch GB/s over
 >= 5 epochs / >= the time budget), "best_epoch" the fastest single
 epoch, "parse_cpu_gbps_core" the thread-CPU parse rate (immune to this
@@ -40,9 +45,13 @@ for older readers; "value" deliberately excludes replay),
 forced over its cache budget: parse-epoch vs page-replay-epoch rates
 and their speedup — the ISSUE-2 acceptance number), "bound" whether
 the best epoch waited mainly on transfers or on parse, "pipeline" the
-best epoch's per-stage stats snapshot + the autotune report, and
-vs_baseline is value / 2.0 (the BASELINE.json target of 2 GB/s/chip;
-the reference publishes no numbers of its own, see BASELINE.md).
+best epoch's per-stage stats snapshot + the autotune report, "metrics"
+the obs metrics-registry snapshot taken at the best epoch (queue
+collectors, engine counters, profiler aggregates — the versioned
+obs.metrics schema), "trace" the --trace output path (null without
+--trace), and vs_baseline is value / 2.0 (the BASELINE.json target of
+2 GB/s/chip; the reference publishes no numbers of its own, see
+BASELINE.md).
 
 Secondary diagnostics go to stderr.
 """
@@ -98,6 +107,15 @@ def ensure_native() -> bool:
 
 
 def main() -> None:
+    # --trace out.json: validated FIRST — a missing path must fail in
+    # milliseconds, not after minutes of warmup epochs
+    trace_path = None
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        if i + 1 >= len(sys.argv):
+            log("--trace requires an output path")
+            sys.exit(2)
+        trace_path = sys.argv[i + 1]
     size = ensure_data()
     have_native = ensure_native()
     import jax
@@ -191,13 +209,22 @@ def main() -> None:
     budget_s = float(os.environ.get("DMLC_TPU_BENCH_BUDGET_S", "60"))
     min_epochs = max(3, int(os.environ.get("DMLC_TPU_BENCH_MIN_EPOCHS", "5")))
     # DMLC_TPU_TRACE=<dir>: dump a jax.profiler device timeline of one
-    # epoch (utils.profiler.trace) for offline inspection
+    # epoch (obs.trace.jax_trace) for offline inspection
     trace_dir = os.environ.get("DMLC_TPU_TRACE")
     if trace_dir:
-        from dmlc_tpu.utils.profiler import trace
-        with trace("bench_epoch", log_dir=trace_dir):
+        from dmlc_tpu.obs.trace import jax_trace
+        with jax_trace("bench_epoch", log_dir=trace_dir):
             epoch()
         log(f"jax.profiler trace written to {trace_dir}")
+
+    # --trace (parsed at the top of main): record the measurement
+    # epochs with the obs trace recorder and export Chrome/Perfetto
+    # trace-event JSON — per-stage pull spans, queue waits, transfer
+    # drains, and the native engine's counters as counter tracks
+    from dmlc_tpu.obs import metrics as obs_metrics
+    from dmlc_tpu.obs import trace as obs_trace
+    if trace_path:
+        obs_trace.start()
 
     # Every epoch is tagged with a host-memcpy credit gauge (~50 ms,
     # VERDICT r4 #5): this burstable VM's CPU credits swing wall rates
@@ -211,6 +238,7 @@ def main() -> None:
     best_stats = None
     best_waits = (0.0, 0.0)
     best_snap = None
+    best_metrics = None
     t_start = time.perf_counter()
     i = 0
     while True:
@@ -223,10 +251,21 @@ def main() -> None:
         if best is None or dt < best:
             best, best_stats, best_waits = dt, stats, (t_pull, t_xfer)
             best_snap = snap
+            # the registry snapshot AT the best epoch: queue
+            # collectors, engine counters, profiler aggregates — the
+            # versioned obs.metrics schema, embedded in BENCH JSON
+            best_metrics = obs_metrics.REGISTRY.snapshot()
         i += 1
         elapsed = time.perf_counter() - t_start
         if i >= min_epochs and elapsed > budget_s:
             break
+    if trace_path:
+        rec = obs_trace.stop()
+        if rec is not None:
+            from dmlc_tpu.obs.export import write_chrome
+            write_chrome(rec, trace_path)
+            log(f"obs trace: {len(rec.events())} events "
+                f"({rec.dropped} dropped) -> {trace_path}")
     # 20%-per-side trimmed mean of per-epoch rates: robust to both burst
     # windows and throttle windows of the credit scheduler
 
@@ -417,6 +456,11 @@ def main() -> None:
             "knobs": best_snap["knobs"] if best_snap else None,
             "autotune": autotune_report,
         },
+        # obs metrics-registry snapshot taken at the best epoch
+        # (schema: dmlc_tpu.obs.metrics.METRICS_SCHEMA)
+        "metrics": best_metrics,
+        # Chrome/Perfetto trace of the measurement epochs (--trace)
+        "trace": trace_path,
     }))
 
 

@@ -14,6 +14,9 @@ Re-designed (not ported) from the reference `trivialfis/dmlc-core`:
 - ``dmlc_tpu.ops``      — JAX/TPU ops over CSR batches (SpMV etc.; new —
   the reference has no device compute, this is the TPU-native seam)
 - ``dmlc_tpu.native``   — C++ hot path (parse/split/prefetch) via ctypes
+- ``dmlc_tpu.obs``      — unified observability: trace recorder with
+  Chrome/Perfetto export, metrics registry, stall watchdog, rate-limited
+  log channel (new — see docs/observability.md)
 
 The hot byte path (sharding, parsing) has two implementations with identical
 semantics: a pure-Python golden (always available, used for parity tests) and a

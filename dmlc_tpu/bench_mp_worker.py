@@ -30,6 +30,14 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 
 
 def main() -> int:
+    # gang-trace opt-in: under launch_local(trace_dir=...) each worker
+    # exports a rank-tagged Chrome trace (merged on clean gang exit)
+    from dmlc_tpu.obs.trace import trace_if_env
+    with trace_if_env():
+        return _run()
+
+
+def _run() -> int:
     data_uri, out_dir = sys.argv[1], sys.argv[2]
     import jax
     import numpy as np

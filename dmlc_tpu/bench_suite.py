@@ -796,6 +796,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="include device transfer in config 4")
     ap.add_argument("--cold", action="store_true",
                     help="skip the warm-up pass (report first-run numbers)")
+    ap.add_argument("--trace", default=None, metavar="OUT_JSON",
+                    help="record the measured run of each config with "
+                         "the dmlc_tpu.obs trace recorder and export "
+                         "Chrome/Perfetto trace-event JSON (one file "
+                         "per config when several run)")
     args = ap.parse_args(argv)
     picks = [args.config] if args.config else sorted(CONFIGS)
     for n in picks:
@@ -809,8 +814,19 @@ def main(argv: Optional[List[str]] = None) -> None:
             # be pure wasted minutes
             if not args.cold and n not in (7, 8, 9, 10):
                 fn(args.mb, args.device)  # warm imports + page cache
-            out = fn(args.mb, args.device)
+            trace_path = None
+            if args.trace:
+                trace_path = (args.trace if len(picks) == 1
+                              else f"{args.trace}.config{n}.json")
+                from dmlc_tpu.obs.trace import trace_to
+                with trace_to(trace_path):
+                    out = fn(args.mb, args.device)
+                _log(f"obs trace -> {trace_path}")
+            else:
+                out = fn(args.mb, args.device)
             out["gbps"] = round(out["gbps"], 4)
+            if trace_path:
+                out["trace"] = trace_path
             _emit(out)
         except Exception as e:  # noqa: BLE001
             _emit({"config": name, "error": str(e)[:200]})

@@ -132,7 +132,8 @@ class ParquetParser(Parser):
     def next(self) -> bool:
         if self._prefetch is None and self._want_prefetch:
             from dmlc_tpu.data.threaded_iter import ThreadedIter
-            self._prefetch = ThreadedIter(max_capacity=2)
+            self._prefetch = ThreadedIter(max_capacity=2,
+                                          name="parquet.prefetch")
             self._prefetch.init(self._produce, self._rewind)
         self._block = (self._prefetch.next() if self._prefetch is not None
                        else self._produce())

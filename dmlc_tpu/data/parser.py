@@ -139,7 +139,8 @@ class TextParserBase(Parser):
         self._block: Optional[RowBlock] = None
         self._prefetch: Optional[ThreadedIter] = None
         if prefetch and getattr(self._split, "rewindable", True):
-            self._prefetch = ThreadedIter(max_capacity=prefetch_depth)
+            self._prefetch = ThreadedIter(max_capacity=prefetch_depth,
+                                          name="parse.chunk_prefetch")
             self._prefetch.init(self._split.next_chunk,
                                 self._split.before_first)
 

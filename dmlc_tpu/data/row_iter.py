@@ -187,7 +187,7 @@ class DiskRowIter(RowBlockIter):
         def _rewind():
             self._stream.seek(0)
 
-        self._iter = ThreadedIter(max_capacity=4)
+        self._iter = ThreadedIter(max_capacity=4, name="pages.prefetch")
         self._iter.init(_next_page, _rewind)
 
     def _close(self) -> None:
@@ -288,11 +288,14 @@ class RoundSpillWriter:
         self.rounds += 1
 
     def commit(self) -> "RoundSpillFile":
-        ser.write_u32(self._s, _SPILL_END_MAGIC)
-        ser.write_u64(self._s, self.rounds)
-        self._s.close()
-        self._s = None
-        os.replace(self._tmp, self.path)
+        from dmlc_tpu.obs import trace as _trace
+        with _trace.span("spill.commit", "io",
+                         {"rounds": self.rounds, "path": self.path}):
+            ser.write_u32(self._s, _SPILL_END_MAGIC)
+            ser.write_u64(self._s, self.rounds)
+            self._s.close()
+            self._s = None
+            os.replace(self._tmp, self.path)
         return RoundSpillFile(self.path, self.nparts, self.rounds)
 
     def abort(self) -> None:

@@ -22,6 +22,9 @@ import threading
 import time
 from typing import Any, Callable, Generic, Optional, TypeVar
 
+from dmlc_tpu.obs import trace as _trace
+from dmlc_tpu.obs import watchdog as _watchdog
+from dmlc_tpu.obs.metrics import REGISTRY as _METRICS
 from dmlc_tpu.utils.logging import DMLCError, check
 
 T = TypeVar("T")
@@ -30,11 +33,27 @@ _DATA, _END, _EXC = 0, 1, 2
 
 
 class ThreadedIter(Generic[T]):
-    """Background prefetch with faithful exception semantics."""
+    """Background prefetch with faithful exception semantics.
 
-    def __init__(self, max_capacity: int = 8):
+    Observability (dmlc_tpu.obs): BLOCKING producer/consumer waits on
+    any ThreadedIter become trace spans (``<name>.producer_wait`` /
+    ``<name>.consumer_wait``) and watchdog-registered waits — the
+    watchdog must see every queue that can wedge, named or not.
+    Unnamed queues record under the generic ``threaded_iter`` label
+    (the stall report still distinguishes them by thread and queue
+    detail); ``name`` additionally registers the queue's ``stats()``
+    as a metrics collector ``queue/<name>`` until destroy(). Cost when
+    no recorder/watchdog is installed: one module-global read per
+    blocked wait; non-blocking operation is untouched.
+    """
+
+    def __init__(self, max_capacity: int = 8, name: Optional[str] = None):
         check(max_capacity >= 1, "max_capacity must be >= 1")
         self._cap = max_capacity
+        self.name = name
+        self._metrics_key = (
+            _METRICS.register(f"queue/{name}", self, ThreadedIter._metrics)
+            if name else None)
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
@@ -112,14 +131,26 @@ class ThreadedIter(Generic[T]):
         """
         with self._lock:
             t0 = None
+            token = None
             while len(self._queue) >= self._cap:
                 if self._destroyed or self._epoch != epoch:
+                    _watchdog.end_wait(token)
                     return False
                 if t0 is None:
                     t0 = time.perf_counter()
+                    token = _watchdog.begin_wait(
+                        f"{self.name or 'threaded_iter'}.producer_wait",
+                        self._wait_detail)
                 self._not_full.wait()
             if t0 is not None:
-                self._producer_block_s += time.perf_counter() - t0
+                _watchdog.end_wait(token)
+                dt = time.perf_counter() - t0
+                self._producer_block_s += dt
+                rec = _trace.active()
+                if rec is not None:
+                    rec.complete(
+                        f"{self.name or 'threaded_iter'}.producer_wait",
+                        t0, dt, "queue")
             if self._destroyed or self._epoch != epoch:
                 return False
             self._queue.append((epoch, kind, payload))
@@ -138,10 +169,26 @@ class ThreadedIter(Generic[T]):
             return None
         while True:
             with self._lock:
+                t0 = None
+                token = None
                 while not self._queue:
                     if self._destroyed:
+                        _watchdog.end_wait(token)
                         return None
+                    if t0 is None:
+                        t0 = time.perf_counter()
+                        token = _watchdog.begin_wait(
+                            f"{self.name or 'threaded_iter'}"
+                            ".consumer_wait", self._wait_detail)
                     self._not_empty.wait()  # _emit/destroy always notify
+                if t0 is not None:
+                    _watchdog.end_wait(token)
+                    rec = _trace.active()
+                    if rec is not None:
+                        rec.complete(
+                            f"{self.name or 'threaded_iter'}"
+                            ".consumer_wait", t0,
+                            time.perf_counter() - t0, "queue")
                 epoch, kind, payload = self._queue.pop(0)
                 self._not_full.notify()
                 if epoch != self._epoch:
@@ -173,6 +220,21 @@ class ThreadedIter(Generic[T]):
             return {"produced": self._produced,
                     "producer_block_s": round(self._producer_block_s, 6)}
 
+    def _wait_detail(self) -> dict:
+        """Watchdog diagnosis sample. Lock-free on purpose: called
+        from the watchdog thread while a producer/consumer may be
+        blocked — approximate-but-deadlock-proof beats exact."""
+        return {"qsize": len(self._queue), "capacity": self._cap,
+                "produced": self._produced, "ended": self._ended,
+                "producer_block_s": round(self._producer_block_s, 6)}
+
+    def _metrics(self) -> dict:
+        """Registered metrics-collector shape (obs.metrics)."""
+        with self._lock:
+            return {"qsize": len(self._queue), "capacity": self._cap,
+                    "produced": self._produced,
+                    "producer_block_s": round(self._producer_block_s, 6)}
+
     @property
     def capacity(self) -> int:
         return self._cap
@@ -201,6 +263,9 @@ class ThreadedIter(Generic[T]):
 
     def destroy(self) -> None:
         """Stop the producer and join (reference: Destroy/dtor)."""
+        if self._metrics_key is not None:
+            _METRICS.unregister(self._metrics_key)
+            self._metrics_key = None
         with self._lock:
             self._destroyed = True
             self._not_full.notify_all()

@@ -25,12 +25,14 @@ composition is provided too, TPU-natively:
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from dmlc_tpu.io.stream import create_stream
+from dmlc_tpu.obs import trace as _trace
 from dmlc_tpu.utils import serializer as ser
 from dmlc_tpu.utils.json_util import json_dump, json_load
 from dmlc_tpu.utils.logging import DMLCError, check, check_eq
@@ -38,6 +40,20 @@ from dmlc_tpu.utils.logging import DMLCError, check, check_eq
 __all__ = ["save_pytree", "load_pytree", "ShardedCheckpoint"]
 
 _FORMAT_VERSION = 1
+
+
+def _spanned(name: str):
+    """Record the call as one obs trace span (no-op when tracing is
+    off) — checkpoint save/restore shows up on the timeline next to
+    the pipeline's pulls, so "epoch N was slow" and "epoch N contained
+    a checkpoint" stop being separate investigations."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _trace.span(name, "checkpoint"):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
 
 
 def _intersect(a: tuple, b: tuple) -> Optional[tuple]:
@@ -63,6 +79,7 @@ def _flatten(tree: Any) -> Tuple[List[Tuple[str, Any]], Any]:
     return out, treedef
 
 
+@_spanned("checkpoint.save_pytree")
 def save_pytree(tree: Any, uri: str) -> None:
     """Serialize a pytree of arrays to one stream (single-host path)."""
     leaves, _ = _flatten(tree)
@@ -74,6 +91,7 @@ def save_pytree(tree: Any, uri: str) -> None:
             ser.write_ndarray(s, np.asarray(leaf))
 
 
+@_spanned("checkpoint.load_pytree")
 def load_pytree(uri: str, like: Optional[Any] = None) -> Any:
     """Load a checkpoint; returns {key: array}, or the structure of
     ``like`` when given (keys must match)."""
@@ -162,6 +180,7 @@ class ShardedCheckpoint:
 
     # -- save
 
+    @_spanned("checkpoint.save")
     def save(self, step: int, tree: Any,
              metadata: Optional[Dict[str, Any]] = None) -> str:
         import jax
@@ -332,6 +351,7 @@ class ShardedCheckpoint:
 
     # -- restore
 
+    @_spanned("checkpoint.restore")
     def restore(self, step: Optional[int] = None, like: Any = None,
                 sharding_tree: Any = None) -> Tuple[Any, Dict[str, Any]]:
         """Load (tree, user_metadata). ``like`` supplies structure (and

@@ -18,7 +18,8 @@ __all__ = ["ThreadedInputSplit"]
 class ThreadedInputSplit(InputSplit):
     def __init__(self, base: InputSplit, max_capacity: int = 4):
         self._base = base
-        self._iter = ThreadedIter(max_capacity=max_capacity)
+        self._iter = ThreadedIter(max_capacity=max_capacity,
+                                  name="split.chunks")
         self._iter.init(base.next_chunk, base.before_first)
         self._recbuf = []
         self._recpos = 0
@@ -44,7 +45,7 @@ class ThreadedInputSplit(InputSplit):
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         self._iter.destroy()
         self._base.reset_partition(part_index, num_parts)
-        self._iter = ThreadedIter(max_capacity=4)
+        self._iter = ThreadedIter(max_capacity=4, name="split.chunks")
         self._iter.init(self._base.next_chunk, self._base.before_first)
         self._recbuf, self._recpos = [], 0
 

@@ -38,9 +38,14 @@ def native_available() -> bool:
                 # and keep the reason for get_lib()'s error
                 _lib = None
                 _load_error = str(e)
-                from dmlc_tpu.utils.logging import log_warning
-                log_warning(f"native engine present but unusable "
-                            f"({_load_error}); using Python engines")
+                # all_ranks: the .so is HOST-local — in an ssh gang
+                # one host's stale build silently costs that rank ~10x
+                # while rank 0's loads fine, so every rank must say it
+                from dmlc_tpu.obs.log import warn_once
+                warn_once("native-engine-unusable",
+                          f"native engine present but unusable "
+                          f"({_load_error}); using Python engines",
+                          all_ranks=True)
     return _lib is not None
 
 

@@ -19,6 +19,7 @@ from dmlc_tpu.data.parser import Parser
 from dmlc_tpu.data.rowblock import RowBlock
 from dmlc_tpu.io.input_split import list_split_files
 from dmlc_tpu.io.uri_spec import URISpec
+from dmlc_tpu.obs.metrics import REGISTRY as _METRICS
 from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
@@ -242,6 +243,14 @@ class NativeTextParser(Parser):
         self._block: Optional[RowBlock] = None
         self._lease: Optional[BlockLease] = None
         self._init_outparams()
+        # engine counters join the process metrics registry: one
+        # obs.metrics snapshot sees reader/parse busy-ns next to the
+        # Python-side queue stats (weakly held; destroy() unregisters)
+        self._metrics_key = _METRICS.register(
+            f"native/{self._format}", self, type(self)._metrics_stats)
+
+    def _metrics_stats(self) -> Optional[Dict[str, int]]:
+        return self.stats() if getattr(self, "_handle", None) else None
 
     def _init_outparams(self) -> None:
         # out-params allocated once; the C call overwrites them per block
@@ -375,6 +384,9 @@ class NativeTextParser(Parser):
         return int(self._lib.dtp_parser_bytes_read(self._handle))
 
     def destroy(self) -> None:
+        if getattr(self, "_metrics_key", None):
+            _METRICS.unregister(self._metrics_key)
+            self._metrics_key = None
         if getattr(self, "_handle", None):
             if self._lease is not None:
                 self._lease.release()
@@ -548,6 +560,11 @@ class NativeRecordIOReader:
             raise DMLCError(f"native recordio create failed: "
                             f"{lib.dtp_last_error().decode()}")
         self._lease: Optional[_RecioLease] = None
+        self._metrics_key = _METRICS.register(
+            "native/recordio", self, NativeRecordIOReader._metrics_stats)
+
+    def _metrics_stats(self) -> Optional[Dict[str, int]]:
+        return self.stats() if getattr(self, "_handle", None) else None
 
     def next_batch(self):
         """(payload, starts, ends) numpy views for one chunk's records,
@@ -610,6 +627,9 @@ class NativeRecordIOReader:
                 "decode_cpu_ns": int(out[6])}
 
     def destroy(self) -> None:
+        if getattr(self, "_metrics_key", None):
+            _METRICS.unregister(self._metrics_key)
+            self._metrics_key = None
         if getattr(self, "_handle", None):
             if self._lease is not None:
                 self._lease.release()

@@ -1,0 +1,41 @@
+"""dmlc_tpu.obs — unified observability: tracing, metrics, watchdog.
+
+One place to see where time went and why a pull wedged, across the
+Python and native layers (docs/observability.md):
+
+- :mod:`~dmlc_tpu.obs.trace` — thread-aware span/instant/counter ring
+  buffer, near-zero cost when off; the repo's ONE span API (the old
+  ``utils.profiler`` is a shim over it);
+- :mod:`~dmlc_tpu.obs.export` — Chrome/Perfetto trace-event JSON
+  export + gang trace merging;
+- :mod:`~dmlc_tpu.obs.metrics` — counters/gauges/histograms plus the
+  registered ``stats()`` surfaces, one versioned ``snapshot()``;
+- :mod:`~dmlc_tpu.obs.watchdog` — stall detection over every
+  instrumented wait, with a single diagnosis report (blocked stage,
+  queue state, metrics snapshot, all-thread stacks);
+- :mod:`~dmlc_tpu.obs.log` — the rate-limited, gang-deduplicated
+  warn channel.
+"""
+
+from dmlc_tpu.obs.export import (
+    chrome_events, merge_chrome_files, write_chrome,
+)
+from dmlc_tpu.obs.log import warn_limited, warn_once
+from dmlc_tpu.obs.metrics import (
+    METRICS_SCHEMA, REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
+    merge_snapshots,
+)
+from dmlc_tpu.obs.trace import (
+    Profiler, StageStats, TraceRecorder, counter, instant, jax_trace,
+    profiler, span, start, stop, trace_to,
+)
+from dmlc_tpu.obs.watchdog import Watchdog
+
+__all__ = [
+    "TraceRecorder", "span", "instant", "counter", "start", "stop",
+    "trace_to", "Profiler", "StageStats", "profiler", "jax_trace",
+    "chrome_events", "write_chrome", "merge_chrome_files",
+    "MetricsRegistry", "REGISTRY", "Counter", "Gauge", "Histogram",
+    "merge_snapshots", "METRICS_SCHEMA",
+    "Watchdog", "warn_once", "warn_limited",
+]

@@ -1,0 +1,116 @@
+"""Chrome/Perfetto trace-event JSON export + gang-trace merging.
+
+One exporter for every :class:`~dmlc_tpu.obs.trace.TraceRecorder`:
+``chrome_events()`` renders the ring buffer into trace-event dicts
+(the `Trace Event Format`_ required keys — ``ph``/``ts``/``pid``/
+``tid``/``name`` — are pinned by tests/test_obs.py), ``write_chrome()``
+wraps them in the ``{"traceEvents": [...]}`` envelope Perfetto and
+chrome://tracing both load, and ``merge_chrome_files()`` concatenates
+per-worker trace files from a :mod:`dmlc_tpu.parallel.launch` gang onto
+one timeline — events stay distinguishable because every process tags
+its own ``pid`` (and a rank-named process_name metadata track).
+
+.. _Trace Event Format: https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from dmlc_tpu.obs.metrics import worker_rank
+from dmlc_tpu.obs.trace import TraceRecorder
+
+__all__ = ["chrome_events", "write_chrome", "merge_chrome_files",
+           "worker_rank"]
+
+
+def chrome_events(rec: TraceRecorder,
+                  pid: Optional[int] = None,
+                  process_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Render a recorder's ring buffer as trace-event dicts.
+
+    Spans become complete ("X") events, instants "i", counter samples
+    "C" (one track per counter name, one series per dict key — the
+    shape Perfetto draws as stacked counter tracks). Metadata ("M")
+    events name the process (rank-tagged when launched in a gang) and
+    every recording thread.
+    """
+    if pid is None:
+        pid = os.getpid()
+    rank = worker_rank()
+    if process_name is None:
+        process_name = (f"dmlc_tpu rank {rank}" if rank is not None
+                        else f"dmlc_tpu pid {pid}")
+    out: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0, "ts": 0,
+        "args": {"name": process_name},
+    }]
+    for ident, tname in sorted(rec.thread_names().items()):
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": ident, "ts": 0, "args": {"name": tname}})
+    for ph, name, cat, t_s, dur_s, tid, args in rec.events():
+        ev: Dict[str, Any] = {
+            "ph": ph, "name": name, "pid": pid, "tid": tid,
+            "ts": round(rec.ts_us(t_s), 3),
+        }
+        if cat:
+            ev["cat"] = cat
+        if ph == "X":
+            ev["dur"] = round(dur_s * 1e6, 3)
+            if args:
+                ev["args"] = args
+        elif ph == "i":
+            ev["s"] = "t"  # thread-scoped instant
+            if args:
+                ev["args"] = args
+        else:  # "C": args IS the series dict
+            ev["args"] = args or {}
+        out.append(ev)
+    return out
+
+
+def write_chrome(rec: TraceRecorder, path: str,
+                 pid: Optional[int] = None,
+                 process_name: Optional[str] = None) -> Dict[str, Any]:
+    """Export one recorder to a Chrome trace-event JSON file. Returns
+    the envelope that was written (handy for tests)."""
+    doc = {
+        "traceEvents": chrome_events(rec, pid=pid,
+                                     process_name=process_name),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "recorded": rec.recorded,
+            "dropped": rec.dropped,
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return doc
+
+
+def merge_chrome_files(paths: List[str], out_path: str) -> Dict[str, Any]:
+    """Concatenate per-worker trace files onto one timeline.
+
+    Every worker exports with its own ``pid`` and a rank-tagged
+    process_name track, and timestamps are wall-anchored at recording
+    time (obs.trace), so merging is pure concatenation — Perfetto lays
+    the gang out as one process row per rank."""
+    events: List[Dict[str, Any]] = []
+    meta: List[Dict[str, Any]] = []
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        events.extend(doc.get("traceEvents", []))
+        meta.append({"file": os.path.basename(p),
+                     **doc.get("otherData", {})})
+    merged = {"traceEvents": events, "displayTimeUnit": "ms",
+              "otherData": {"merged_from": meta}}
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out_path)
+    return merged

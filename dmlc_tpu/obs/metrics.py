@@ -1,0 +1,254 @@
+"""Process-wide metrics registry: counters, gauges, histograms, and
+registered ``stats()`` collectors under ONE versioned snapshot schema.
+
+Before obs/, ``stats()`` lived in five unrelated shapes (ThreadedIter,
+the native bindings, the profiler, CompiledPipeline, BufferPool) and a
+reader had to know each one. Those surfaces keep their methods — their
+callers depend on the shapes — but every instance now REGISTERS into
+the global :data:`REGISTRY` so one ``snapshot()`` call sees them all:
+
+- **Counter / Gauge / Histogram** — the primitive instruments for new
+  code (monotonic count, last-set value, log2-bucketed distribution);
+- **collectors** — weakly-held objects with a dict-returning stats
+  function, polled at snapshot time. Weak registration means an
+  iterator that gets garbage-collected silently leaves the registry;
+  ``destroy()``-style teardown can also unregister eagerly.
+
+``snapshot()`` returns a plain-JSON dict with a versioned schema
+(:data:`METRICS_SCHEMA`, pinned by tests/test_obs.py), pid/rank-tagged
+so per-worker snapshots from a gang can be merged side-by-side with
+:func:`merge_snapshots` (the metrics analogue of merged trace files).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "REGISTRY", "merge_snapshots", "worker_rank",
+           "METRICS_SCHEMA"]
+
+# bump when snapshot()'s top-level shape changes incompatibly
+METRICS_SCHEMA = 1
+
+
+def worker_rank() -> Optional[int]:
+    """This process's gang rank under the parallel.launch env contract
+    (DMLC_TPU_TASK_ID, reference-name alias accepted); None standalone
+    or when the var is malformed. The ONE implementation — obs.export
+    and obs.log read rank through here."""
+    for name in ("DMLC_TPU_TASK_ID", "DMLC_TASK_ID"):
+        v = os.environ.get(name)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return None
+
+
+class Counter:
+    """Monotonic count (events, bytes, items)."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-set value; numeric or a small state string (e.g. the
+    replay tier serving the current epoch)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value: Any = None
+
+    def set(self, value: Any) -> None:
+        self._value = value
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+
+class Histogram:
+    """Log2-bucketed distribution summary (count/sum/min/max + bucket
+    counts keyed by upper bound). Cheap enough for per-pull waits."""
+
+    __slots__ = ("_lock", "count", "total", "min", "max", "_buckets")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._buckets: Dict[float, int] = {}
+
+    @staticmethod
+    def _bucket(v: float) -> float:
+        if v <= 0:
+            return 0.0
+        b = 1e-6
+        while b < v:
+            b *= 2
+        return b
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            b = self._bucket(v)
+            self._buckets[b] = self._buckets.get(b, 0) + 1
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"count": self.count, "sum": round(self.total, 9),
+                    "min": self.min, "max": self.max,
+                    "buckets": {repr(k): v for k, v in
+                                sorted(self._buckets.items())}}
+
+
+def _jsonable(v: Any) -> Any:
+    """Best-effort conversion of collector output to plain JSON."""
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if hasattr(v, "__dataclass_fields__"):
+        return {f: _jsonable(getattr(v, f)) for f in v.__dataclass_fields__}
+    if isinstance(v, (bool, str)) or v is None:
+        return v
+    if isinstance(v, (int, float)):
+        return v
+    if hasattr(v, "item"):  # numpy scalar
+        try:
+            return v.item()
+        except Exception:  # noqa: BLE001
+            return repr(v)
+    return repr(v)
+
+
+class MetricsRegistry:
+    """get-or-create instruments + weakly-registered collectors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        # name -> (weakref to owner, fn(owner) -> dict)
+        self._collectors: Dict[str, tuple] = {}
+        self._seq = itertools.count(2)
+
+    # -- instruments
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name, Histogram())
+
+    # -- collectors (the existing stats() surfaces)
+
+    def register(self, name: str, owner: Any,
+                 fn: Callable[[Any], Dict[str, Any]]) -> str:
+        """Register ``fn(owner)`` as a snapshot collector. ``owner`` is
+        held WEAKLY: a collected owner drops out of snapshots on its
+        own. Name collisions get a ``#N`` suffix; the actual name is
+        returned (pass it to :meth:`unregister`)."""
+        with self._lock:
+            self._prune_locked()
+            actual = name
+            while actual in self._collectors:
+                actual = f"{name}#{next(self._seq)}"
+            self._collectors[actual] = (weakref.ref(owner), fn)
+            return actual
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._collectors.pop(name, None)
+
+    def _prune_locked(self) -> None:
+        dead = [n for n, (ref, _) in self._collectors.items()
+                if ref() is None]
+        for n in dead:
+            del self._collectors[n]
+
+    # -- snapshot
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Freeze everything into the versioned plain-JSON shape. A
+        collector that raises reports ``None`` instead of killing the
+        snapshot (telemetry must never take down the pipeline)."""
+        with self._lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            gauges = {n: _jsonable(g.value)
+                      for n, g in self._gauges.items()}
+            hists = {n: h.summary() for n, h in self._histograms.items()}
+            collectors = dict(self._collectors)
+        polled: Dict[str, Any] = {}
+        for name, (ref, fn) in sorted(collectors.items()):
+            owner = ref()
+            if owner is None:
+                continue
+            try:
+                polled[name] = _jsonable(fn(owner))
+            except Exception:  # noqa: BLE001 — a torn-down owner
+                polled[name] = None
+        return {
+            "schema": METRICS_SCHEMA,
+            "pid": os.getpid(),
+            "rank": worker_rank(),
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+            "collectors": polled,
+        }
+
+    def reset(self) -> None:
+        """Drop every instrument and collector (tests)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._collectors.clear()
+
+
+REGISTRY = MetricsRegistry()  # the process-global registry
+
+
+def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Combine per-worker snapshots into one gang view, keyed by rank
+    (falling back to pid) — the report shape for multiprocess runs."""
+    workers: Dict[str, Any] = {}
+    for s in snaps:
+        key = (f"rank{s['rank']}" if s.get("rank") is not None
+               else f"pid{s.get('pid')}")
+        while key in workers:
+            key += "'"
+        workers[key] = s
+    return {"schema": METRICS_SCHEMA, "workers": workers}

@@ -1,0 +1,211 @@
+"""Stall watchdog: turn a wedged pull into a diagnosis report.
+
+The pre-PR-1 suite once hung for 870 s with zero diagnostics — a
+blocked queue pull looks exactly like a slow one from the outside.
+Every instrumented wait (ThreadedIter producer/consumer blocking,
+pipeline stage pulls) now registers with this module while it blocks:
+:func:`begin_wait`/:func:`end_wait` cost one dict write when a
+watchdog is installed and a single global read when none is.
+
+A running :class:`Watchdog` polls the registered waits; any wait older
+than ``threshold_s`` produces ONE diagnosis report per stall naming
+the blocked stage(s), how long each has been blocked, the live detail
+each wait carries (queue occupancy/capacity, producer counters, replay
+tier), a full metrics-registry snapshot (spill state, engine stats —
+whatever the process registered), and ``faulthandler`` stacks of every
+thread. The report lands as JSON at ``report_path`` (plus a warning
+through obs.log) and in ``self.reports`` for tests/tooling.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["Watchdog", "begin_wait", "end_wait", "active"]
+
+_lock = threading.Lock()
+_seq = itertools.count(1)
+# key -> (name, t0_perf, detail_fn, thread_name)
+_waits: Dict[int, tuple] = {}
+_active: Optional["Watchdog"] = None
+
+
+def active() -> Optional["Watchdog"]:
+    return _active
+
+
+def begin_wait(name: str,
+               detail_fn: Optional[Callable[[], Dict[str, Any]]] = None
+               ) -> Optional[int]:
+    """Register a (potentially) blocking pull. Returns a token for
+    :func:`end_wait`, or None (free) when no watchdog is installed."""
+    if _active is None:
+        return None
+    key = next(_seq)
+    entry = (name, time.perf_counter(), detail_fn,
+             threading.current_thread().name)
+    with _lock:
+        _waits[key] = entry
+    return key
+
+
+def end_wait(key: Optional[int]) -> None:
+    if key is None:
+        return
+    with _lock:
+        _waits.pop(key, None)
+
+
+def _thread_stacks() -> str:
+    """All-thread stacks via faulthandler (needs a real fd)."""
+    try:
+        with tempfile.TemporaryFile(mode="w+") as f:
+            faulthandler.dump_traceback(file=f, all_threads=True)
+            f.seek(0)
+            return f.read()
+    except Exception as e:  # noqa: BLE001 — diagnostics must not raise
+        return f"<stack dump failed: {e}>"
+
+
+class Watchdog:
+    """Poll instrumented waits; report any that block past the
+    threshold. One report per stall instance: a wait keeps its token
+    for its whole blocked life, so a reported token is remembered and
+    not re-reported while it stays blocked."""
+
+    def __init__(self, threshold_s: float = 30.0,
+                 interval_s: Optional[float] = None,
+                 report_path: Optional[str] = None,
+                 on_stall: Optional[Callable[[Dict[str, Any]], None]]
+                 = None):
+        self.threshold_s = float(threshold_s)
+        self.interval_s = (interval_s if interval_s is not None
+                           else max(0.05, min(1.0, threshold_s / 4)))
+        self.report_path = report_path
+        self.on_stall = on_stall
+        self.reports: List[Dict[str, Any]] = []
+        self._reported: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle
+
+    def start(self) -> "Watchdog":
+        global _active
+        if self._thread is not None:
+            return self
+        # ONE watchdog owns the shared wait registry: stopping a still-
+        # running predecessor here prevents its poll thread from
+        # double-reporting every stall next to ours
+        prev = _active
+        if prev is not None and prev is not self:
+            prev.stop()
+        _active = self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dmlc_tpu.obs.Watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop polling. The shared wait registry is NOT cleared:
+        entries remove themselves via end_wait when their pull
+        unblocks, and a pull that is STILL blocked must stay visible
+        to a successor watchdog (blocked waits never re-register — a
+        clear here would permanently blind the successor to exactly
+        the stall it was started to catch)."""
+        global _active
+        if _active is self:
+            _active = None
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- polling
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.check()
+
+    def check(self) -> Optional[Dict[str, Any]]:
+        """One poll: report if any registered wait exceeds the
+        threshold (also callable directly from tests)."""
+        now = time.perf_counter()
+        with _lock:
+            stalled = [(k, e) for k, e in _waits.items()
+                       if now - e[1] >= self.threshold_s
+                       and k not in self._reported]
+        if not stalled:
+            return None
+        blocked = []
+        for key, (name, t0, detail_fn, tname) in stalled:
+            detail = None
+            if detail_fn is not None:
+                try:
+                    detail = detail_fn()
+                except Exception as e:  # noqa: BLE001
+                    detail = {"error": repr(e)}
+            blocked.append({"name": name,
+                            "blocked_s": round(now - t0, 3),
+                            "thread": tname,
+                            "detail": detail})
+            self._reported.add(key)
+        report = self._build_report(blocked)
+        self.reports.append(report)
+        self._deliver(report)
+        return report
+
+    def _build_report(self, blocked: List[Dict[str, Any]]
+                      ) -> Dict[str, Any]:
+        from dmlc_tpu.obs.metrics import REGISTRY
+        try:
+            metrics = REGISTRY.snapshot()
+        except Exception as e:  # noqa: BLE001
+            metrics = {"error": repr(e)}
+        return {
+            "kind": "dmlc_tpu_stall_report",
+            "time": time.time(),
+            "pid": os.getpid(),
+            "threshold_s": self.threshold_s,
+            "blocked": blocked,
+            "metrics": metrics,
+            "stacks": _thread_stacks(),
+        }
+
+    def _deliver(self, report: Dict[str, Any]) -> None:
+        names = ", ".join(b["name"] for b in report["blocked"])
+        path_note = ""
+        if self.report_path:
+            try:
+                tmp = self.report_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(report, f, indent=1)
+                os.replace(tmp, self.report_path)
+                path_note = f" — report: {self.report_path}"
+            except Exception as e:  # noqa: BLE001
+                path_note = f" — report write failed: {e}"
+        from dmlc_tpu.obs.log import warn_limited
+        warn_limited(
+            "watchdog-stall",
+            f"Watchdog: pull(s) blocked > {self.threshold_s}s: "
+            f"{names}{path_note}", min_interval_s=self.interval_s,
+            all_ranks=True)
+        if self.on_stall is not None:
+            try:
+                self.on_stall(report)
+            except Exception:  # noqa: BLE001 — user callback
+                pass

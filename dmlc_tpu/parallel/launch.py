@@ -37,8 +37,12 @@ from dmlc_tpu.utils.logging import DMLCError, check
 __all__ = [
     "worker_envs", "ps_envs", "get_role", "init_from_env", "finalize",
     "launch_local", "launch_ssh", "get_ring", "get_tree", "get_link_map",
-    "find_free_port", "find_free_ports", "main",
+    "find_free_port", "find_free_ports", "merge_gang_traces", "main",
 ]
+
+# workers that wrap their run in obs.trace.trace_if_env() export a
+# rank-tagged Chrome trace into this dir (launch_local(trace_dir=...))
+ENV_TRACE_DIR = "DMLC_TPU_TRACE_DIR"
 
 # env contract (reference: slave_envs in tracker.py)
 ENV_COORD = "DMLC_TPU_COORDINATOR_URI"
@@ -181,7 +185,8 @@ def launch_local(num_workers: int, command: Sequence[str],
                  env: Optional[Dict[str, str]] = None,
                  coordinator: Optional[str] = None,
                  timeout: Optional[float] = None,
-                 num_servers: int = 0) -> List[int]:
+                 num_servers: int = 0,
+                 trace_dir: Optional[str] = None) -> List[int]:
     """Run N worker processes on this host (reference: local.py).
 
     With ``num_servers > 0`` (reference: dmlc-submit --num-servers +
@@ -191,11 +196,29 @@ def launch_local(num_workers: int, command: Sequence[str],
     ``get_role()``. Workers carry BOTH contracts; the jax gang is
     workers-only.
 
+    ``trace_dir`` hands every worker the obs tracing contract
+    (``DMLC_TPU_TRACE_DIR``): workers that wrap their run in
+    ``dmlc_tpu.obs.trace.trace_if_env()`` each export a rank-tagged
+    Chrome trace there, and on a clean gang exit the per-worker files
+    are merged into ``<trace_dir>/trace-gang.json`` — one Perfetto
+    timeline, one process row per rank.
+
     Returns the list of exit codes (workers first in task-id order,
     then scheduler, then servers). Raises if any process fails.
     """
     check(num_workers >= 1, "num_workers must be >= 1")
     check(num_servers >= 0, "num_servers must be >= 0")
+    if trace_dir is not None:
+        import glob
+        os.makedirs(trace_dir, exist_ok=True)
+        # stale trace-*.json from a previous gang (e.g. a 4-worker run
+        # reusing a 2-worker run's dir) would merge as ghost rank rows
+        # on the new timeline — this launch owns the dir's trace files
+        for stale in glob.glob(os.path.join(trace_dir, "trace-*.json")):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
     ps_root: Optional[Tuple[str, int]] = None
     if coordinator is None and num_servers > 0:
         # one probe pass holding both sockets: back-to-back single-port
@@ -230,6 +253,8 @@ def launch_local(num_workers: int, command: Sequence[str],
             if env:
                 wenv.update(env)
             wenv.update(worker_envs(coordinator, num_workers, task_id))
+            if trace_dir is not None:
+                wenv[ENV_TRACE_DIR] = trace_dir
             if ps_root is not None:
                 wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
                                     num_servers, "worker", task_id))
@@ -279,7 +304,27 @@ def launch_local(num_workers: int, command: Sequence[str],
         raise
     if any(codes):
         raise DMLCError(f"worker failure, exit codes {codes}")
+    if trace_dir is not None:
+        merge_gang_traces(trace_dir)
     return codes
+
+
+def merge_gang_traces(trace_dir: str,
+                      out_name: str = "trace-gang.json") -> Optional[str]:
+    """Merge the per-worker ``trace-*.json`` files a traced gang left
+    in ``trace_dir`` into one Perfetto-loadable timeline. Returns the
+    merged path, or None when no worker exported a trace (workers opt
+    in via obs.trace.trace_if_env())."""
+    import glob
+    out_path = os.path.join(trace_dir, out_name)
+    paths = sorted(p for p in glob.glob(os.path.join(trace_dir,
+                                                     "trace-*.json"))
+                   if os.path.abspath(p) != os.path.abspath(out_path))
+    if not paths:
+        return None
+    from dmlc_tpu.obs.export import merge_chrome_files
+    merge_chrome_files(paths, out_path)
+    return out_path
 
 
 def launch_ssh(hosts: Sequence[str], command: Sequence[str],

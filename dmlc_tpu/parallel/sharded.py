@@ -23,7 +23,7 @@ Padded rows are compute-neutral: weight 0, empty; padded nnz: value 0.
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.data.rowblock import RowBlock
 from dmlc_tpu.utils.logging import (
-    DMLCError, check, check_eq, check_le, log_warning,
+    DMLCError, check, check_eq, check_le,
 )
 
 __all__ = ["pad_to_bucket", "stack_device_batches", "make_global_batch",
@@ -356,6 +356,14 @@ class ShardedRowBlockIter:
             self._ctor_sizes = list_split_files(uri)
         except Exception:  # noqa: BLE001 — non-stat-able backing
             self._ctor_sizes = None
+        # per-iterator obs collector (weakly held): replay tier +
+        # epoch counters land in one metrics snapshot per LIVE
+        # iterator, next to the queue/engine surfaces
+        from dmlc_tpu.obs.metrics import REGISTRY as _registry
+        import os as _os
+        self._obs_key = _registry.register(
+            f"shard/{_os.path.basename(uri.split('?', 1)[0])}",
+            self, ShardedRowBlockIter._metrics)
 
     def _first_epoch_batches(self) -> Iterator[Dict[str, jax.Array]]:
         """Epoch 1: agree on rounds-per-epoch across processes.
@@ -595,8 +603,12 @@ class ShardedRowBlockIter:
                 self._add_row(blocks)
             except Exception as e:  # noqa: BLE001 — a full/unwritable
                 # disk must degrade to "no replay", never kill the epoch
-                log_warning(f"ShardedRowBlockIter: replay spill failed "
-                            f"({e}); steady epochs will re-parse")
+                from dmlc_tpu.obs.log import warn_limited
+                warn_limited(
+                    "sharded-spill-failed",
+                    f"ShardedRowBlockIter: replay spill failed "
+                    f"({e}); steady epochs will re-parse",
+                    min_interval_s=60.0, all_ranks=True)
                 self._abandon()
 
         def _add_row(self, blocks: List[RowBlock]) -> None:
@@ -645,9 +657,12 @@ class ShardedRowBlockIter:
                 except Exception as e:  # noqa: BLE001 — same degrade-
                     # to-no-replay contract as add_row: a commit-time
                     # ENOSPC/unlink must not kill a COMPLETE epoch
-                    log_warning(
+                    from dmlc_tpu.obs.log import warn_limited
+                    warn_limited(
+                        "sharded-spill-commit-failed",
                         f"ShardedRowBlockIter: replay spill commit "
-                        f"failed ({e}); steady epochs will re-parse")
+                        f"failed ({e}); steady epochs will re-parse",
+                        min_interval_s=60.0, all_ranks=True)
                     self._abandon()
                     return
                 it._round_store = ShardedRowBlockIter._PageRounds(
@@ -715,7 +730,8 @@ class ShardedRowBlockIter:
         lets the autotuner drive the shard.prefetch knob) and its
         producer stats land in ``_serve_stats`` at epoch end."""
         from dmlc_tpu.data.threaded_iter import ThreadedIter
-        ti = ThreadedIter(max_capacity=self.prefetch_depth)
+        ti = ThreadedIter(max_capacity=self.prefetch_depth,
+                          name="shard.serve")
         ti.init(make_next)
         self._serve_queue = ti
         try:
@@ -798,7 +814,15 @@ class ShardedRowBlockIter:
                 ("field", self._has_field, has_field)) if new and not seen]
             if flipped:
                 self._schema_warned = True
-                log_warning(
+                # obs.log channel, rank 0 only in a gang — every rank
+                # detects the same flip, N copies say nothing new.
+                # min_interval_s=0: the per-instance flag above owns
+                # the once-semantics (an id(self)-keyed dedup could
+                # silently eat a DIFFERENT iterator's warning after
+                # CPython reuses the address)
+                from dmlc_tpu.obs.log import warn_limited
+                warn_limited(
+                    "sharded-schema-flip",
                     f"ShardedRowBlockIter: optional column(s) "
                     f"{'/'.join(flipped)} first appeared after "
                     f"{self._schema_rounds} assembled round(s) — the "
@@ -806,7 +830,8 @@ class ShardedRowBlockIter:
                     "from earlier rounds and from replay/re-parse epochs "
                     "(expect jit recompiles / pytree-structure "
                     "mismatches). Supply uniform columns: tag every row "
-                    "(qid) / every feature (field), or none.")
+                    "(qid) / every feature (field), or none.",
+                    min_interval_s=0.0)
         self._has_qid |= has_qid
         self._has_field |= has_field
 
@@ -992,9 +1017,24 @@ class ShardedRowBlockIter:
         return make_global_batch(self._assemble_stacked(blocks),
                                  self.mesh, self.axis)
 
+    def _note_tier(self, tier: str) -> None:
+        """Stamp the tier serving this epoch; the per-iterator obs
+        collector (``shard/<uri-base>``) surfaces it, so a stall
+        report names each live iterator's OWN tier — a process-global
+        gauge would show whichever iterator last started an epoch."""
+        self.replay_tier = tier
+
+    def _metrics(self) -> Dict[str, Any]:
+        """obs.metrics collector shape (registered weakly at
+        construction, pruned with the iterator)."""
+        return {"replay_tier": self.replay_tier,
+                "replay_epochs": self.replay_epochs,
+                "page_replay_epochs": self.page_replay_epochs,
+                "prefetch_depth": self.prefetch_depth}
+
     def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
         if self._rounds_per_epoch is None:
-            self.replay_tier = "parse"
+            self._note_tier("parse")
             yield from self._first_epoch_batches()
             return
         self._check_not_shrunk()
@@ -1012,7 +1052,7 @@ class ShardedRowBlockIter:
             if (self._fingerprint is not None
                     and self._fingerprint == self._fingerprint_now()):
                 self.replay_epochs += 1
-                self.replay_tier = self._round_store.tier
+                self._note_tier(self._round_store.tier)
                 if self.replay_tier == "pages":
                     self.page_replay_epochs += 1
                 yield from self._replay_store(self._round_store)
@@ -1034,7 +1074,7 @@ class ShardedRowBlockIter:
         # mutated-then-stable file re-earns replay after one clean
         # re-parse epoch. A shard whose previous store was pages is
         # known over budget — skip the doomed memory accumulation.
-        self.replay_tier = "parse"
+        self._note_tier("parse")
         tee = self._make_tee(self._fingerprint_now(),
                              force_spill=self._was_pages)
         try:
@@ -1050,6 +1090,10 @@ class ShardedRowBlockIter:
         spill file) and destroy the parsers. Safe to call twice; also
         invoked from __del__ so an abandoned iterator cannot leak spill
         files past process exit by accident."""
+        if getattr(self, "_obs_key", None):
+            from dmlc_tpu.obs.metrics import REGISTRY as _registry
+            _registry.unregister(self._obs_key)
+            self._obs_key = None
         store, self._round_store = self._round_store, None
         if store is not None:
             store.drop()

@@ -38,6 +38,9 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from dmlc_tpu.obs import trace as _trace
+from dmlc_tpu.obs import watchdog as _watchdog
+from dmlc_tpu.obs.metrics import REGISTRY as _METRICS
 from dmlc_tpu.pipeline.autotune import Autotuner, Knob
 from dmlc_tpu.pipeline.stages import StageSpec, validate_chain
 from dmlc_tpu.pipeline.stats import StageProbe, snapshot
@@ -50,17 +53,39 @@ _END = object()
 
 def _probed(runner) -> Iterator:
     """Pull a runner's epoch through its probe: every boundary crossing
-    records wait time, volume, and (when queue-backed) occupancy."""
+    records wait time, volume, and (when queue-backed) occupancy.
+
+    Observability contract (tests/test_obs.py pins it): with a trace
+    recorder active, every DELIVERED item emits exactly one complete
+    span named ``pull/<stage>`` whose duration is the SAME perf_counter
+    pair the probe accumulates into ``wait_s`` — so per-stage span
+    totals and probe waits agree by construction (the terminal
+    end-of-stream wait goes to ``pull/<stage>.end`` to keep the
+    span-count == items invariant exact). Each pull also registers
+    with the stall watchdog while it blocks."""
     gen = runner.epoch()
     probe = runner.probe
+    pull_name = f"pull/{probe.name}"      # loop-invariant: built once,
+    end_name = pull_name + ".end"         # not per delivered item
     while True:
+        rec = _trace.active()
+        token = _watchdog.begin_wait(pull_name, runner.wait_detail)
         t0 = time.perf_counter()
-        item = next(gen, _END)
+        try:
+            item = next(gen, _END)
+        finally:
+            # a raising stage must not leave a phantom wait registered
+            # — the watchdog would later report a stall that never was
+            _watchdog.end_wait(token)
         dt = time.perf_counter() - t0
         if item is _END:
             probe.record_wait_only(dt)
+            if rec is not None:
+                rec.complete(end_name, t0, dt, "pipeline")
             return
         probe.record(item, dt, runner.queue)
+        if rec is not None:
+            rec.complete(pull_name, t0, dt, "pipeline")
         yield item
 
 
@@ -78,6 +103,23 @@ class _RunnerBase:
     def queue(self):
         """Live bounded queue for occupancy sampling, or None."""
         return None
+
+    def wait_detail(self) -> Dict[str, Any]:
+        """Watchdog diagnosis sample for a blocked pull at this stage:
+        queue state when queue-backed, plus stage extras (replay tier,
+        serve stats) the runner recorded so far."""
+        out: Dict[str, Any] = {"kind": self.kind,
+                               "items": self.probe.items}
+        q = self.queue
+        if q is not None:
+            try:
+                out["qsize"] = q.qsize()
+                out["capacity"] = q.capacity
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
+        if self.probe.extra:
+            out["extra"] = dict(self.probe.extra)
+        return out
 
     def epoch(self) -> Iterator:
         raise NotImplementedError
@@ -178,7 +220,11 @@ class _ParseRunner(_RunnerBase):
         stats_fn = getattr(self._parser, "stats", None)
         if stats_fn is not None:
             try:
-                self.probe.extra["engine"] = stats_fn()
+                engine = stats_fn()
+                self.probe.extra["engine"] = engine
+                # native-engine counters as a trace counter track: the
+                # reader/parse busy split rides next to the spans
+                _trace.counter("engine", engine, "native")
             except Exception:  # noqa: BLE001 — telemetry must not kill
                 pass
         try:
@@ -473,7 +519,8 @@ class _PrefetchRunner(_RunnerBase):
         self._auto = depth == "auto"
         from dmlc_tpu.data.threaded_iter import ThreadedIter
         self._ti = ThreadedIter(
-            max_capacity=4 if self._auto else int(depth))
+            max_capacity=4 if self._auto else int(depth),
+            name="prefetch")
         self._src: Optional[Iterator] = None
         self._started = False
 
@@ -598,8 +645,13 @@ class _DeviceRunner(_RunnerBase):
             fut, lease = in_flight.popleft()
             t0 = time.perf_counter()
             jax.block_until_ready(fut)
-            xfer_wait += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            xfer_wait += dt
             self.probe.extra["xfer_wait_s"] = round(xfer_wait, 6)
+            rec = _trace.active()
+            if rec is not None:
+                rec.complete("to_device.drain", t0, dt, "transfer",
+                             {"in_flight": len(in_flight) + 1})
             if lease is not None:
                 lease.release()
             return fut
@@ -655,6 +707,14 @@ class CompiledPipeline:
         self.autotuner = autotuner
         self._epoch = 0
         self._last: Optional[Dict[str, Any]] = None
+        # the pipeline's stats() registers as an obs metrics collector:
+        # one REGISTRY.snapshot() sees the last epoch's stage stats
+        # next to queue/engine/profiler surfaces (docs/observability.md)
+        self._metrics_key = _METRICS.register(
+            "pipeline", self, CompiledPipeline._last_snapshot)
+
+    def _last_snapshot(self) -> Optional[Dict[str, Any]]:
+        return self._last
 
     # -- iteration
 
@@ -698,11 +758,28 @@ class CompiledPipeline:
         return (self.autotuner.report()
                 if self.autotuner is not None else None)
 
+    def trace(self, path: str, capacity: int = 1 << 20):
+        """Record a Chrome/Perfetto trace of everything run inside the
+        block and export it to ``path`` on exit::
+
+            with built.trace("epoch.json"):
+                for batch in built:
+                    step(batch)
+
+        Every stage pull becomes a ``pull/<stage>`` span, queue waits
+        and transfer drains appear on their own threads, and native
+        engine counters ride as counter tracks (dmlc_tpu.obs.trace;
+        installs the global recorder for the duration)."""
+        return _trace.trace_to(path, capacity)
+
     @property
     def epochs(self) -> int:
         return self._epoch
 
     def close(self) -> None:
+        if self._metrics_key is not None:
+            _METRICS.unregister(self._metrics_key)
+            self._metrics_key = None
         for r in reversed(self._runners):
             r.close()
 

@@ -30,7 +30,6 @@ Stage-specific ``extra`` fields (additive, schema version unchanged):
 
 from __future__ import annotations
 
-import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -75,7 +74,7 @@ class StageProbe:
 
     __slots__ = ("name", "kind", "items", "rows", "nnz", "bytes",
                  "wait_s", "occupancy_sum", "occupancy_samples",
-                 "queue_cap", "extra", "_t_epoch0")
+                 "queue_cap", "extra")
 
     def __init__(self, name: str, kind: str):
         self.name = name
@@ -93,7 +92,6 @@ class StageProbe:
         self.occupancy_samples = 0
         self.queue_cap: Optional[int] = None
         self.extra = {}
-        self._t_epoch0 = time.perf_counter()
 
     def record(self, item, wait_s: float, queue=None) -> None:
         """One delivered item: wait seconds + volume + queue sample."""

@@ -17,7 +17,9 @@ from dmlc_tpu.utils.thread_group import (
     ManualEvent, ThreadGroup, ThreadLocalStore,
 )
 from dmlc_tpu.utils.memory import BufferPool, thread_local_pool
-from dmlc_tpu.utils.profiler import Profiler, profiler
+# canonical home since the obs/ subsystem; utils.profiler is a
+# deprecation shim over these same objects
+from dmlc_tpu.obs.trace import Profiler, profiler
 
 __all__ = [
     "DMLCError", "check", "check_eq", "check_ne", "check_lt", "check_le",

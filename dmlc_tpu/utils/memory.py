@@ -64,6 +64,11 @@ class BufferPool:
     def stats(self) -> Tuple[int, int]:
         return self.allocated, self.reused
 
+    def _metrics(self) -> dict:
+        """obs.metrics collector shape (stats() keeps its tuple for
+        existing callers)."""
+        return {"allocated": self.allocated, "reused": self.reused}
+
 
 _tls = threading.local()
 
@@ -73,4 +78,10 @@ def thread_local_pool() -> BufferPool:
     pool = getattr(_tls, "pool", None)
     if pool is None:
         pool = _tls.pool = BufferPool()
+        # weakly registered: the pool leaves the snapshot with its
+        # thread; the name carries the owning thread for gang readers
+        from dmlc_tpu.obs.metrics import REGISTRY
+        REGISTRY.register(
+            f"buffer_pool/{threading.current_thread().name}",
+            pool, BufferPool._metrics)
     return pool

@@ -1,102 +1,29 @@
-"""Pipeline profiling: stage timers, byte/sec counters, jax traces.
+"""DEPRECATED shim — the span API moved to :mod:`dmlc_tpu.obs.trace`.
 
-Reference: the reference's only instrumentation is include/dmlc/timer.h
-and the throughput printf in test/dataiter_test.cc (SURVEY.md §5.1).
-The TPU build upgrades this to a first-class subsystem: per-stage
-wall-time/byte counters for the loader pipeline, and an optional
-jax.profiler trace context for device-side inspection.
+This module was the repo's second, overlapping span surface. Its whole
+API (``Profiler``/``StageStats``/the global ``profiler``/``trace``) now
+lives in ``dmlc_tpu.obs.trace``, where ``Profiler.stage()`` also feeds
+the trace-event ring buffer, so there is ONE span vocabulary. Importing
+names from here keeps working but warns once; ``trace`` is the old name
+of :func:`dmlc_tpu.obs.trace.jax_trace`.
 """
 
 from __future__ import annotations
 
-import contextlib
-import threading
-import time
-from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional
+import warnings
 
-__all__ = ["Profiler", "StageStats", "profiler", "trace"]
+_EXPORTS = {"Profiler", "StageStats", "profiler", "trace"}
+
+__all__ = sorted(_EXPORTS)
 
 
-@dataclass
-class StageStats:
-    calls: int = 0
-    seconds: float = 0.0
-    bytes: int = 0
-    items: int = 0
-
-    @property
-    def gb_per_sec(self) -> float:
-        return self.bytes / self.seconds / 1e9 if self.seconds else 0.0
-
-
-class Profiler:
-    """Named-stage accumulator; thread-safe."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._stages: Dict[str, StageStats] = {}
-        self.enabled = True
-
-    @contextlib.contextmanager
-    def stage(self, name: str, nbytes: int = 0,
-              items: int = 0) -> Iterator[None]:
-        if not self.enabled:
-            yield
-            return
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                st = self._stages.setdefault(name, StageStats())
-                st.calls += 1
-                st.seconds += dt
-                st.bytes += nbytes
-                st.items += items
-
-    def add(self, name: str, seconds: float = 0.0, nbytes: int = 0,
-            items: int = 0) -> None:
-        with self._lock:
-            st = self._stages.setdefault(name, StageStats())
-            st.calls += 1
-            st.seconds += seconds
-            st.bytes += nbytes
-            st.items += items
-
-    def stats(self) -> Dict[str, StageStats]:
-        with self._lock:
-            return dict(self._stages)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._stages.clear()
-
-    def report(self) -> str:
-        lines = [f"{'stage':<24}{'calls':>8}{'sec':>10}{'GB':>10}"
-                 f"{'GB/s':>10}{'items':>10}"]
-        for name, st in sorted(self.stats().items()):
-            lines.append(
-                f"{name:<24}{st.calls:>8}{st.seconds:>10.3f}"
-                f"{st.bytes / 1e9:>10.3f}{st.gb_per_sec:>10.3f}"
-                f"{st.items:>10}")
-        return "\n".join(lines)
-
-
-profiler = Profiler()  # process-global default instance
-
-
-@contextlib.contextmanager
-def trace(name: str, log_dir: Optional[str] = None) -> Iterator[None]:
-    """Wrap a region in a jax.profiler trace (device timeline) when
-    log_dir is given, else a named TraceAnnotation; always also feeds the
-    process profiler."""
-    import jax
-    with profiler.stage(name):
-        if log_dir is not None:
-            with jax.profiler.trace(log_dir):
-                yield
-        else:
-            with jax.profiler.TraceAnnotation(name):
-                yield
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        warnings.warn(
+            "dmlc_tpu.utils.profiler is deprecated; use dmlc_tpu.obs "
+            "(obs.trace.Profiler / obs.trace.jax_trace)",
+            DeprecationWarning, stacklevel=2)
+        from dmlc_tpu.obs import trace as _trace
+        return _trace.jax_trace if name == "trace" else getattr(_trace,
+                                                                name)
+    raise AttributeError(name)

@@ -7,6 +7,12 @@ without the tools baked in:
 - **Built-in checks** (always run, stdlib only): every tracked .py file
   must parse (ast), use spaces-only indentation, carry no trailing
   whitespace, no CR line endings, and end with exactly one newline.
+- **Observability gate** (always run, AST-based): inside the
+  ``dmlc_tpu`` package, bare ``print(`` calls and new ad-hoc ``def
+  stats(`` dict surfaces are forbidden outside ``dmlc_tpu/obs/`` — new
+  telemetry registers into ``dmlc_tpu.obs.metrics`` and logs through
+  ``dmlc_tpu.obs.log``. Pre-obs surfaces are pinned in an allowlist;
+  the list shrinks, it does not grow.
 - **ruff** over the Python tree and **clang-format --dry-run -Werror**
   over native/src/ — run when the binaries are importable/installed,
   reported as skipped otherwise.
@@ -83,6 +89,55 @@ def builtin_lint(paths: List[str]) -> List[str]:
     return findings
 
 
+# pre-obs surfaces, pinned (package-relative paths). print(): the two
+# CLI-style emitters whose stdout IS their interface and the build
+# script. stats(): the five shapes that now REGISTER into
+# dmlc_tpu.obs.metrics (the methods stay for their callers). New code
+# uses obs.metrics / obs.log instead of growing this list.
+PRINT_ALLOWED = {
+    "dmlc_tpu/native/build.py",
+    "dmlc_tpu/bench_transfer.py",
+    "dmlc_tpu/bench_suite.py",
+}
+STATS_ALLOWED = {
+    "dmlc_tpu/data/threaded_iter.py",
+    "dmlc_tpu/native/bindings.py",
+    "dmlc_tpu/pipeline/graph.py",
+    "dmlc_tpu/utils/memory.py",
+}
+
+
+def obs_lint(paths: List[str]) -> List[str]:
+    """The observability gate: no new bare print()/ad-hoc stats() dict
+    shapes inside dmlc_tpu/ outside obs/ (see module docstring)."""
+    findings: List[str] = []
+    for path in paths:
+        rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+        if not rel.startswith("dmlc_tpu/") or rel.startswith("dmlc_tpu/obs/"):
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=rel)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue  # builtin_lint already reports these
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                    and rel not in PRINT_ALLOWED):
+                findings.append(
+                    f"{rel}:{node.lineno}: bare print() in package code "
+                    "— log through dmlc_tpu.obs.log / utils.logging")
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == "stats"
+                    and rel not in STATS_ALLOWED):
+                findings.append(
+                    f"{rel}:{node.lineno}: new stats() surface — "
+                    "register a collector with dmlc_tpu.obs.metrics."
+                    "REGISTRY instead of inventing a dict shape")
+    return findings
+
+
 def run_ruff(root: str = REPO) -> Optional[List[str]]:
     """ruff findings, or None when ruff is not installed."""
     cmd = None
@@ -121,7 +176,9 @@ def run_clang_format(root: str = NATIVE_SRC) -> Optional[List[str]]:
 
 
 def main() -> int:
-    findings = builtin_lint(python_files())
+    paths = python_files()
+    findings = builtin_lint(paths)
+    findings += obs_lint(paths)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",
@@ -137,7 +194,7 @@ def main() -> int:
     for f in findings:
         print(f, file=sys.stderr)
     print(f"lint: {len(findings)} finding(s) over "
-          f"{len(python_files())} python files", file=sys.stderr)
+          f"{len(paths)} python files", file=sys.stderr)
     return 1 if findings else 0
 
 

@@ -46,3 +46,24 @@ class TestLintGate:
         bad.write_text("def f(:\n    pass\n")
         findings = lint.builtin_lint([str(bad)])
         assert any("syntax error" in f for f in findings)
+
+    def test_obs_gate_clean(self):
+        # no bare print()/ad-hoc stats() surfaces crept into dmlc_tpu/
+        # outside obs/ and the pinned pre-obs allowlists
+        findings = lint.obs_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_obs_gate_catches_planted_violations(self):
+        # the gate must bite on package files outside the allowlists —
+        # plant one in-memory via a real temp file under dmlc_tpu/
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe.py")
+        with open(bad, "w") as f:
+            f.write("def stats():\n    return {}\n\n\n"
+                    "def run():\n    print('x')\n")
+        try:
+            findings = lint.obs_lint([bad])
+        finally:
+            os.remove(bad)
+        kinds = "\n".join(findings)
+        assert "bare print()" in kinds
+        assert "new stats() surface" in kinds

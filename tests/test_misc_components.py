@@ -17,7 +17,7 @@ from dmlc_tpu.parallel.backends import (
 )
 from dmlc_tpu.utils.logging import DMLCError
 from dmlc_tpu.utils.memory import BufferPool, thread_local_pool
-from dmlc_tpu.utils.profiler import Profiler
+from dmlc_tpu.obs.trace import Profiler
 from dmlc_tpu.utils.thread_group import ManualEvent, ThreadGroup
 
 
