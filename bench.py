@@ -118,6 +118,16 @@ def main() -> None:
         trace_path = sys.argv[i + 1]
     size = ensure_data()
     have_native = ensure_native()
+    # live telemetry opt-ins (no-ops without their env vars): with
+    # DMLC_TPU_SERVE_PORT set the measurement epochs are scrapeable
+    # (curl :PORT/metrics) while they run; with DMLC_TPU_FLIGHT_DIR a
+    # crash mid-bench leaves a post-mortem bundle
+    from dmlc_tpu.obs.flight import install_if_env
+    from dmlc_tpu.obs.serve import serve_if_env
+    srv = serve_if_env()
+    if srv is not None:
+        log(f"obs status server: http://127.0.0.1:{srv.port}/metrics")
+    install_if_env()
     import jax
     import numpy as np
     from dmlc_tpu.data.parser import Parser

@@ -30,9 +30,15 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 
 
 def main() -> int:
-    # gang-trace opt-in: under launch_local(trace_dir=...) each worker
-    # exports a rank-tagged Chrome trace (merged on clean gang exit)
+    # live-telemetry opt-ins (each a no-op without its env var): the
+    # per-rank status server under launch_local(serve_ports=...), the
+    # crash flight recorder under launch_local(flight_dir=...), and the
+    # rank-tagged gang trace under launch_local(trace_dir=...)
+    from dmlc_tpu.obs.flight import install_if_env
+    from dmlc_tpu.obs.serve import serve_if_env
     from dmlc_tpu.obs.trace import trace_if_env
+    serve_if_env()
+    install_if_env()
     with trace_if_env():
         return _run()
 

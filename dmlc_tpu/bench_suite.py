@@ -802,6 +802,16 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "Chrome/Perfetto trace-event JSON (one file "
                          "per config when several run)")
     args = ap.parse_args(argv)
+    # live telemetry opt-ins (no-ops without their env vars): a set
+    # DMLC_TPU_SERVE_PORT makes the running configs scrapeable
+    # (/metrics, /healthz), DMLC_TPU_FLIGHT_DIR leaves a post-mortem
+    # bundle if a config dies badly
+    from dmlc_tpu.obs.flight import install_if_env
+    from dmlc_tpu.obs.serve import serve_if_env
+    srv = serve_if_env()
+    if srv is not None:
+        _log(f"obs status server: http://127.0.0.1:{srv.port}/metrics")
+    install_if_env()
     picks = [args.config] if args.config else sorted(CONFIGS)
     for n in picks:
         name, fn = CONFIGS[n]

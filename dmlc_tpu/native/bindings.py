@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes as C
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -30,8 +31,9 @@ __all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
 _lib = None
 
 # Must equal dtp_version() in engine.cc. Bumped on every C ABI signature
-# change (3: dtp_parser_create grew the `sparse` argument).
-ABI_VERSION = 3
+# change (3: dtp_parser_create grew the `sparse` argument; 4: span-ring
+# trace surface).
+ABI_VERSION = 4
 
 
 def load(path: str):
@@ -120,7 +122,17 @@ def load(path: str):
     lib.dtp_parse_float64.restype = C.c_int
     lib.dtp_parse_float64.argtypes = [C.c_char_p, C.c_int64,
                                       C.POINTER(C.c_double)]
+    lib.dtp_trace_set_enabled.argtypes = [C.c_int]
+    lib.dtp_trace_enabled.restype = C.c_int
+    lib.dtp_now_ns.restype = C.c_int64
+    lib.dtp_parser_trace_drain.restype = C.c_int64
+    lib.dtp_parser_trace_drain.argtypes = [
+        C.c_void_p, C.POINTER(C.c_int64), C.c_int64]
     _lib = lib
+    # the tracing global may already be on when the engine loads late
+    # (obs.trace only mirrors into an ALREADY-loaded lib)
+    from dmlc_tpu.obs import trace as _obs_trace
+    lib.dtp_trace_set_enabled(1 if _obs_trace.active() is not None else 0)
     return lib
 
 
@@ -200,6 +212,31 @@ class BlockLease:
             self.release()
         except Exception:
             pass
+
+
+# native span ring (engine.cc SpanRing): event kind -> (ph, timeline
+# name); "X" = complete span, "i" = instant. The engine's small thread
+# ids are offset into their own track range so they can never collide
+# with Python pthread idents (which are pointer-sized).
+_TRACE_KINDS = {
+    1: ("X", "native/chunk_read"),
+    2: ("X", "native/tokenize"),
+    3: ("X", "native/batch_assemble"),
+    4: ("i", "native/cache.hit"),
+    5: ("i", "native/cache.miss"),
+}
+_NATIVE_TID_BASE = 0x6E000000  # 'n' << 24: the native track range
+_NATIVE_RING_CAP = 4096        # engine.cc SpanRing::kCap
+
+
+def _native_thread_name(tid: int) -> str:
+    if tid == 0:
+        return "native/consumer"
+    if tid == 1:
+        return "native/reader"
+    if tid == 100:
+        return "native/arena-pool"
+    return f"native/worker-{tid - 2}"
 
 
 class NativeTextParser(Parser):
@@ -367,6 +404,42 @@ class NativeTextParser(Parser):
                 "max_chunk_queue_depth": int(out[4]),
                 "max_reorder_depth": int(out[5]),
                 "parse_cpu_ns": int(out[6])}
+
+    def drain_trace(self, rec) -> int:
+        """Drain this parser's native span ring into a
+        :class:`~dmlc_tpu.obs.trace.TraceRecorder`, converting engine
+        steady-clock timestamps onto the recorder's perf_counter
+        timebase (offset calibrated per drain — exact when both are
+        CLOCK_MONOTONIC, which glibc guarantees, and bounded by one
+        syscall's jitter otherwise). Returns the event count. The ring
+        records only while tracing is on (dtp_trace_set_enabled), so
+        with tracing off this returns 0 at the cost of one C call."""
+        if not getattr(self, "_handle", None):
+            return 0
+        buf = (C.c_int64 * (5 * _NATIVE_RING_CAP))()
+        n = int(self._lib.dtp_parser_trace_drain(
+            self._handle, buf, _NATIVE_RING_CAP))
+        if n == 0:
+            return 0
+        off_s = time.perf_counter() - self._lib.dtp_now_ns() / 1e9
+        named = set()
+        for k in range(n):
+            kind, tid, t0_ns, dur_ns, arg = buf[5 * k:5 * k + 5]
+            ph_name = _TRACE_KINDS.get(kind)
+            if ph_name is None:
+                continue
+            ph, name = ph_name
+            rtid = _NATIVE_TID_BASE + tid
+            if rtid not in named:
+                rec.name_thread(rtid, _native_thread_name(tid))
+                named.add(rtid)
+            t0_s = t0_ns / 1e9 + off_s
+            if ph == "X":
+                rec.complete_at(name, t0_s, dur_ns / 1e9, rtid,
+                                "native", {"seq": int(arg)})
+            else:
+                rec.instant_at(name, t0_s, rtid, "native")
+        return n
 
     def set_test_delay_ms(self, ms: int) -> None:
         """Test hook: add a per-chunk parse delay (pipeline-scaling proof

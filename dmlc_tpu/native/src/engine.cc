@@ -1963,6 +1963,125 @@ inline int64_t thread_cpu_ns() {
   return (int64_t)ts.tv_sec * 1000000000 + ts.tv_nsec;
 }
 
+// ------------------------------------------------------------ span ring
+// Per-parser lock-free bounded ring of begin/end span events (chunk
+// read, tokenize, batch assemble, arena-cache hit/miss), drained by
+// the Python side (dtp_parser_trace_drain) and merged onto the same
+// Chrome/Perfetto timeline as the Python spans. Gated by ONE global
+// flag mirroring the Python tracing on/off global (obs.trace): off
+// cost at every record site is a single relaxed load + branch.
+
+std::atomic<int> g_trace_on{0};
+
+// span kinds (bindings.py maps them to timeline names)
+enum TraceKind : int32_t {
+  kTraceChunkRead = 1,      // reader thread: one NextChunk/NextChunkView
+  kTraceTokenize = 2,       // worker: ParseChunkInto over one chunk
+  kTraceBatchAssemble = 3,  // consumer: Next() pop + index fixup
+  kTraceCacheHit = 4,       // instant: arena free-list reuse
+  kTraceCacheMiss = 5,      // instant: fresh arena allocation
+};
+
+// engine-side thread ids (small, disjoint from pthread idents by
+// construction — bindings offsets them into their own track range)
+enum TraceTid : int32_t {
+  kTidConsumer = 0,  // the dtp_parser_next caller
+  kTidReader = 1,    // the shard reader thread
+  kTidWorker0 = 2,   // parse-pool worker w -> kTidWorker0 + w
+  kTidPool = 100,    // arena free-list events (any worker thread)
+};
+
+struct TraceEvt {
+  // stamp = index + 1 once the payload is fully written (release);
+  // kWritingStamp while a writer OWNS the slot (claimed via CAS, so
+  // ownership is exclusive even when a writer lags a full ring lap
+  // behind its peers). The drainer validates stamp before AND after
+  // copying the payload: acceptance requires both loads == index + 1,
+  // and any concurrent claim in between forces a mismatch — a slot
+  // overwritten mid-read is skipped, never torn.
+  std::atomic<uint64_t> stamp{0};
+  int64_t t0_ns = 0;
+  int64_t dur_ns = 0;
+  int64_t arg = 0;
+  int32_t kind = 0;
+  int32_t tid = 0;
+};
+
+class SpanRing {
+ public:
+  static constexpr uint64_t kCap = 4096;
+  static constexpr uint64_t kWritingStamp = ~0ull;
+  SpanRing() : slots_(kCap) {}
+
+  void Record(int32_t kind, int32_t tid, int64_t t0_ns, int64_t dur_ns,
+              int64_t arg) {
+    uint64_t i = widx_.fetch_add(1, std::memory_order_relaxed);
+    TraceEvt& e = slots_[i % kCap];
+    // claim the slot exclusively: two writers can share a slot only a
+    // full ring lap apart (one preempted mid-record for 4096 events);
+    // the laggard finding the slot claimed drops ITS event instead of
+    // interleaving plain stores with the owner's (a torn payload under
+    // a then-valid stamp). The CAS's acquire/release also orders the
+    // payload stores after the claim on weakly-ordered CPUs.
+    uint64_t cur = e.stamp.load(std::memory_order_relaxed);
+    do {
+      if (cur == kWritingStamp) {
+        lost_.fetch_add(1, std::memory_order_relaxed);
+        return;
+      }
+    } while (!e.stamp.compare_exchange_weak(cur, kWritingStamp,
+                                            std::memory_order_acq_rel,
+                                            std::memory_order_relaxed));
+    e.t0_ns = t0_ns;
+    e.dur_ns = dur_ns;
+    e.arg = arg;
+    e.kind = kind;
+    e.tid = tid;
+    e.stamp.store(i + 1, std::memory_order_release);
+  }
+
+  // Copy events recorded since the last drain (oldest first, at most
+  // the ring's capacity — older ones were overwritten) into `out` as
+  // 5 int64 per event: [kind, tid, t0_ns, dur_ns, arg]. Single
+  // drainer (the Python caller holds the GIL); producers may still be
+  // writing — slots they own are skipped via the stamp protocol.
+  int64_t Drain(int64_t* out, int64_t max_events) {
+    uint64_t hi = widx_.load(std::memory_order_acquire);
+    uint64_t lo = rd_;
+    if (hi > kCap && lo < hi - kCap) lo = hi - kCap;
+    int64_t n = 0;
+    for (uint64_t i = lo; i < hi && n < max_events; ++i) {
+      TraceEvt& e = slots_[i % kCap];
+      if (e.stamp.load(std::memory_order_acquire) != i + 1) continue;
+      int64_t t0 = e.t0_ns, dur = e.dur_ns, arg = e.arg;
+      int32_t kind = e.kind, tid = e.tid;
+      if (e.stamp.load(std::memory_order_acquire) != i + 1) continue;
+      out[n * 5 + 0] = kind;
+      out[n * 5 + 1] = tid;
+      out[n * 5 + 2] = t0;
+      out[n * 5 + 3] = dur;
+      out[n * 5 + 4] = arg;
+      ++n;
+    }
+    rd_ = hi;
+    return n;
+  }
+
+  uint64_t recorded() const {
+    return widx_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  std::vector<TraceEvt> slots_;
+  std::atomic<uint64_t> widx_{0};
+  std::atomic<uint64_t> lost_{0};  // events dropped at a claimed slot
+  uint64_t rd_ = 0;  // drain cursor (single drainer)
+};
+
+inline bool trace_on() {
+  return g_trace_on.load(std::memory_order_relaxed) != 0;
+}
+
 template <typename T>
 class BoundedQueue {
  public:
@@ -2141,6 +2260,7 @@ struct ParserHandle {
   bool mode_resolved = false;
   std::string error;
   PipelineStats stats;
+  SpanRing ring;  // native span ring, trace_on-gated (drained via ABI)
   size_t max_chunk_depth = 0, max_reorder_depth = 0;  // of last run
 
   // free-lists: arenas (CSR output) and chunk buffers (reader strings),
@@ -2154,15 +2274,20 @@ struct ParserHandle {
   std::map<CSRArena*, std::unique_ptr<CSRArena>> outstanding;
 
   std::unique_ptr<CSRArena> GetArena() {
+    std::unique_ptr<CSRArena> a;
     {
       std::lock_guard<std::mutex> lk(pool_mu);
       if (!arena_pool.empty()) {
-        auto a = std::move(arena_pool.back());
+        a = std::move(arena_pool.back());
         arena_pool.pop_back();
-        a->clear();
-        return a;
       }
     }
+    if (a) {
+      a->clear();
+      if (trace_on()) ring.Record(kTraceCacheHit, kTidPool, now_ns(), 0, 0);
+      return a;
+    }
+    if (trace_on()) ring.Record(kTraceCacheMiss, kTidPool, now_ns(), 0, 0);
     return std::make_unique<CSRArena>();
   }
 
@@ -2231,8 +2356,12 @@ struct ParserHandle {
             item.data = GetChunkBuf();
             more = reader->NextChunk(&item.data);
           }
-          stats.reader_busy_ns += now_ns() - t0;
+          int64_t t1 = now_ns();
+          stats.reader_busy_ns += t1 - t0;
           if (!more) break;
+          if (trace_on())
+            ring.Record(kTraceChunkRead, kTidReader, t0, t1 - t0,
+                        (int64_t)seq);
           item.seq = seq++;
           stats.chunks += 1;
           if (!chunks->Push(std::move(item))) break;
@@ -2249,7 +2378,7 @@ struct ParserHandle {
     });
 
     for (int w = 0; w < nthreads; ++w) {
-      pool.emplace_back([this] {
+      pool.emplace_back([this, w] {
         ChunkItem item;
         while (chunks->Pop(&item)) {
           BlockItem out;
@@ -2278,8 +2407,12 @@ struct ParserHandle {
           } catch (const std::exception& ex) {
             out.error = ex.what();
           }
-          stats.parse_busy_ns += now_ns() - t0;
+          int64_t t1 = now_ns();
+          stats.parse_busy_ns += t1 - t0;
           stats.parse_cpu_ns += thread_cpu_ns() - c0;
+          if (trace_on())
+            ring.Record(kTraceTokenize, kTidWorker0 + w, t0, t1 - t0,
+                        (int64_t)item.seq);
           if (!item.view) RecycleChunkBuf(std::move(item.data));
           if (!blocks->Push(item.seq, std::move(out))) break;
         }
@@ -2293,6 +2426,9 @@ struct ParserHandle {
     if (!blocks) StartPipeline();
     BlockItem item;
     while (blocks->Pop(&item)) {
+      // assemble span starts AFTER the pop: the blocking wait itself
+      // already rides on the Python timeline as the pull/<stage> span
+      int64_t a0 = trace_on() ? now_ns() : 0;
       if (!item.arena) {
         error = item.error;
         last = nullptr;
@@ -2334,6 +2470,9 @@ struct ParserHandle {
         outstanding[raw] = std::move(a);
       }
       last = raw;
+      if (a0)
+        ring.Record(kTraceBatchAssemble, kTidConsumer, a0, now_ns() - a0,
+                    (int64_t)raw->rows());
       return (int64_t)raw->rows();
     }
     last = nullptr;
@@ -2702,9 +2841,40 @@ extern "C" {
 const char* dtp_last_error() { return g_last_error.c_str(); }
 
 // ABI history: 1 = initial; 2 = lease-based dtp_parser_next outparams;
-// 3 = dtp_parser_create grew the 13th `sparse` argument (CSV zero-drop).
+// 3 = dtp_parser_create grew the 13th `sparse` argument (CSV zero-drop);
+// 4 = span-ring trace surface (dtp_trace_set_enabled/dtp_trace_enabled/
+//     dtp_now_ns/dtp_parser_trace_drain).
 // Bump on ANY signature change — bindings.load() refuses mismatches.
-int dtp_version() { return 3; }
+int dtp_version() { return 4; }
+
+// ------------------------------------------------------------- tracing
+
+// Mirror of the Python tracing on/off global (dmlc_tpu.obs.trace):
+// process-wide, so the off cost at every engine record site stays one
+// relaxed load + branch.
+void dtp_trace_set_enabled(int on) {
+  g_trace_on.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
+int dtp_trace_enabled() {
+  return g_trace_on.load(std::memory_order_relaxed);
+}
+
+// The engine's clock (steady_clock ns) for drain-time calibration
+// against Python's perf_counter: bindings measures the offset once per
+// drain, so merged timelines line up regardless of clock identity.
+int64_t dtp_now_ns() { return now_ns(); }
+
+// Drain span events recorded since the last drain (at most the ring
+// capacity; older events were overwritten — that is the bounded-ring
+// contract). `out` receives 5 int64 per event: [kind, tid, t0_ns,
+// dur_ns, arg]. Returns the event count. Call from ONE thread (the
+// Python caller under the GIL).
+int64_t dtp_parser_trace_drain(void* handle, int64_t* out,
+                               int64_t max_events) {
+  auto* h = static_cast<ParserHandle*>(handle);
+  return h->ring.Drain(out, max_events);
+}
 
 // files: paths array; sizes must match the Python VFS listing so the
 // shard contract is identical across engines.

@@ -14,16 +14,26 @@ Python and native layers (docs/observability.md):
   instrumented wait, with a single diagnosis report (blocked stage,
   queue state, metrics snapshot, all-thread stacks);
 - :mod:`~dmlc_tpu.obs.log` — the rate-limited, gang-deduplicated
-  warn channel.
+  warn channel;
+- :mod:`~dmlc_tpu.obs.serve` — the LIVE plane: per-rank in-process
+  HTTP status server (/metrics Prometheus exposition, /healthz,
+  /stacks, on-demand /trace capture) + gang scraping;
+- :mod:`~dmlc_tpu.obs.flight` — the always-on crash flight recorder
+  (small trace ring + periodic metrics, post-mortem bundle on
+  uncaught exception, fatal signal, or watchdog-confirmed stall).
 """
 
 from dmlc_tpu.obs.export import (
     chrome_events, merge_chrome_files, write_chrome,
 )
+from dmlc_tpu.obs.flight import FlightRecorder
 from dmlc_tpu.obs.log import warn_limited, warn_once
 from dmlc_tpu.obs.metrics import (
     METRICS_SCHEMA, REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
     merge_snapshots,
+)
+from dmlc_tpu.obs.serve import (
+    StatusServer, render_prometheus, scrape_gang, serve,
 )
 from dmlc_tpu.obs.trace import (
     Profiler, StageStats, TraceRecorder, counter, instant, jax_trace,
@@ -38,4 +48,6 @@ __all__ = [
     "MetricsRegistry", "REGISTRY", "Counter", "Gauge", "Histogram",
     "merge_snapshots", "METRICS_SCHEMA",
     "Watchdog", "warn_once", "warn_limited",
+    "StatusServer", "serve", "render_prometheus", "scrape_gang",
+    "FlightRecorder",
 ]

@@ -15,6 +15,10 @@ copy PER RANK. This module centralizes the policy:
 
 Messages flow through :func:`dmlc_tpu.utils.logging.log_warning`, so
 ``set_log_sink`` hooks and the glog-style formatting keep working.
+Every EMITTED warning also lands on the trace timeline as an instant
+event (``warn/<key>``, category ``log``) when a recorder is active —
+a rate-limited warning is visible right next to the stall or degrade
+it explains instead of only in a scrolled-away stderr.
 """
 
 from __future__ import annotations
@@ -54,6 +58,8 @@ def warn_limited(key: str, msg: str, min_interval_s: float = 60.0,
             _suppress_count("rate")
             return False
         _last_emit[key] = now
+    from dmlc_tpu.obs.trace import instant
+    instant(f"warn/{key}", "log", {"msg": msg})
     from dmlc_tpu.utils.logging import log_warning
     log_warning(msg)
     return True

@@ -1,0 +1,410 @@
+"""Live telemetry plane: an in-process, stdlib-only HTTP status server.
+
+Every obs surface before this module required a cooperative exit (trace
+files, metrics snapshots) or an up-front flag (``--trace``) — a
+production data plane is monitored while it runs. One daemon thread per
+rank serves:
+
+- ``GET /metrics`` — Prometheus text exposition (0.0.4) rendered from
+  :meth:`~dmlc_tpu.obs.metrics.MetricsRegistry.snapshot`: counters as
+  ``dmlc_*_total``, numeric gauges as gauges, STRING gauges as labeled
+  info-style series (``dmlc_<name>_info{value="pages"} 1`` — a
+  ``Gauge.set("pages")`` must not emit an invalid exposition line),
+  any other non-numeric gauge skipped and counted in
+  ``dmlc_obs_export_skipped_total``, histograms with cumulative
+  ``_bucket{le=...}`` series, and collector dicts flattened to numeric
+  leaves labeled by collector/key;
+- ``GET /metrics.json`` — the raw versioned snapshot (what
+  :func:`scrape_gang` fetches to merge a gang);
+- ``GET /healthz`` — liveness + the instrumented pulls blocked right
+  now (:func:`dmlc_tpu.obs.watchdog.current_waits`);
+- ``GET /stacks`` — an all-thread stack dump;
+- ``GET /trace?seconds=N`` — an on-demand bounded Perfetto capture of
+  the RUNNING pipeline: installs a recorder for N seconds when none is
+  active (restoring the flight ring after), or lets an active ring
+  accumulate N more seconds, then returns the Chrome trace-event JSON.
+
+``launch_local(serve_ports=[...])`` hands every worker a port via
+``DMLC_TPU_SERVE_PORT`` (workers opt in with one :func:`serve_if_env`
+call) plus the full gang list via ``DMLC_TPU_SERVE_PORTS`` so rank 0 —
+or the launcher — can :func:`scrape_gang` the live processes into one
+merged snapshot. "Rerun it with --trace and hope it reproduces"
+becomes "curl the rank that is slow right now".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from dmlc_tpu.obs.metrics import (
+    REGISTRY, MetricsRegistry, merge_snapshots,
+)
+
+__all__ = ["StatusServer", "serve", "serve_if_env", "render_prometheus",
+           "scrape", "scrape_gang", "ENV_SERVE_PORT", "ENV_SERVE_PORTS"]
+
+# env contract (parallel.launch.launch_local(serve_ports=...) sets both)
+ENV_SERVE_PORT = "DMLC_TPU_SERVE_PORT"    # this worker's port
+ENV_SERVE_PORTS = "DMLC_TPU_SERVE_PORTS"  # comma-joined gang ports
+
+# /trace?seconds=N is clamped here: the handler thread sleeps for the
+# capture window and an unbounded N would pin it (and the client)
+MAX_TRACE_CAPTURE_S = 60.0
+
+_name_ok = re.compile(r"[^a-z0-9_]")
+
+
+def _prom_name(name: str, prefix: str = "dmlc_") -> str:
+    """Registry name -> Prometheus metric name ([a-z0-9_], prefixed)."""
+    return prefix + _name_ok.sub("_", name.lower())
+
+
+def _prom_label(value: str) -> str:
+    """Escape a label value per the exposition format (bounded: a
+    runaway state string must not bloat every scrape)."""
+    return (str(value)[:200].replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _num(v: Any) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def _flatten_numeric(prefix: str, value: Any,
+                     out: List[tuple]) -> None:
+    """Collector payloads are arbitrary JSON; keep numeric leaves as
+    (dotted.key.path, number) and drop the rest silently — collectors
+    carry strings by design (replay tiers, error notes)."""
+    if isinstance(value, dict):
+        for k, v in value.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            _flatten_numeric(key, v, out)
+    elif isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            _flatten_numeric(f"{prefix}.{i}", v, out)
+    elif _is_num(value) or isinstance(value, bool):
+        out.append((prefix, value))
+
+
+def render_prometheus(snap: Dict[str, Any],
+                      registry: Optional[MetricsRegistry] = None) -> str:
+    """One snapshot -> Prometheus text exposition (format 0.0.4).
+
+    The rendered families (names/types/HELP lines pinned by
+    tests/test_obs_live.py):
+
+    - ``dmlc_obs_info{rank=...,pid=...,schema=...} 1`` — who answered;
+    - counters  -> ``dmlc_<name>_total`` (TYPE counter);
+    - gauges    -> numeric: ``dmlc_<name>`` (TYPE gauge); string:
+      ``dmlc_<name>_info{value="..."} 1``; anything else (snapshot()
+      reprs unknown objects but passes dicts/lists through) is
+      SKIPPED and counted in ``dmlc_obs_export_skipped_total`` — a
+      structured value has no valid single exposition line;
+    - histograms -> ``_bucket{le=...}`` cumulative + ``_sum``/``_count``;
+    - collectors -> ``dmlc_collector_value{collector=...,key=...}``
+      for every numeric leaf.
+    """
+    reg = registry if registry is not None else REGISTRY
+    skipped = 0
+    lines: List[str] = [
+        "# HELP dmlc_obs_info Identity of the serving process.",
+        "# TYPE dmlc_obs_info gauge",
+        f'dmlc_obs_info{{rank="{_prom_label(snap.get("rank"))}",'
+        f'pid="{_prom_label(snap.get("pid"))}",'
+        f'schema="{_prom_label(snap.get("schema"))}"}} 1',
+    ]
+    for name, value in sorted((snap.get("counters") or {}).items()):
+        if name == "obs.export_skipped":
+            continue  # rendered once at the end with THIS render's
+            # skips included — emitting it here too would duplicate
+            # the family, which Prometheus rejects outright
+        pn = _prom_name(name) + "_total"
+        lines.append(f"# HELP {pn} Counter {name} "
+                     "(dmlc_tpu.obs.metrics).")
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_num(value)}")
+    for name, value in sorted((snap.get("gauges") or {}).items()):
+        pn = _prom_name(name)
+        if _is_num(value) or isinstance(value, bool):
+            lines.append(f"# HELP {pn} Gauge {name} "
+                         "(dmlc_tpu.obs.metrics).")
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {_num(value)}")
+        elif isinstance(value, str):
+            # info-style labeled series: the VALUE rides as a label
+            lines.append(f"# HELP {pn}_info Gauge {name} "
+                         "(non-numeric state, value in label).")
+            lines.append(f"# TYPE {pn}_info gauge")
+            lines.append(f'{pn}_info{{value="{_prom_label(value)}"}} 1')
+        elif value is None:
+            continue  # never-set gauge: nothing to export
+        else:
+            skipped += 1
+    for name, h in sorted((snap.get("histograms") or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# HELP {pn} Histogram {name} "
+                     "(dmlc_tpu.obs.metrics, log2 buckets).")
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        # snapshot buckets are keyed by repr(upper_bound), per-bucket
+        # counts; the exposition wants cumulative le= series
+        try:
+            buckets = sorted((float(k), v)
+                             for k, v in (h.get("buckets") or {}).items())
+        except (TypeError, ValueError):
+            buckets = []
+        for ub, count in buckets:
+            cum += count
+            lines.append(f'{pn}_bucket{{le="{repr(ub)}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h.get("count", 0)}')
+        lines.append(f"{pn}_sum {_num(h.get('sum') or 0)}")
+        lines.append(f"{pn}_count {h.get('count', 0)}")
+    leaves: List[tuple] = []
+    for cname, payload in sorted((snap.get("collectors") or {}).items()):
+        flat: List[tuple] = []
+        _flatten_numeric("", payload, flat)
+        leaves.extend((cname, key, v) for key, v in flat)
+    if leaves:
+        lines.append("# HELP dmlc_collector_value Numeric leaves of "
+                     "registered stats() collectors.")
+        lines.append("# TYPE dmlc_collector_value gauge")
+        for cname, key, v in leaves:
+            lines.append(
+                f'dmlc_collector_value{{collector="{_prom_label(cname)}"'
+                f',key="{_prom_label(key)}"}} {_num(v)}')
+    if skipped:
+        reg.counter("obs.export_skipped").inc(skipped)
+    total = reg.counter("obs.export_skipped").value
+    if total:
+        pn = "dmlc_obs_export_skipped_total"
+        lines.append(f"# HELP {pn} Gauge values not renderable in the "
+                     "exposition (neither numeric nor string).")
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {total}")
+    return "\n".join(lines) + "\n"
+
+
+def _thread_stacks() -> str:
+    """All-thread stack dump (the watchdog's report helper)."""
+    from dmlc_tpu.obs.watchdog import _thread_stacks as dump
+    return dump()
+
+
+def _capture_trace(seconds: float) -> Dict[str, Any]:
+    """On-demand bounded capture of the running process: when no
+    recorder is active, install one for the window (start() displaces
+    the flight ring if installed; stop() reinstates it); when a ring is
+    already live (flight fallback or an explicit trace) let it
+    accumulate the window and export its CURRENT contents without
+    disturbing it."""
+    from dmlc_tpu.obs import trace as _trace
+    from dmlc_tpu.obs.export import chrome_events
+    seconds = max(0.0, min(float(seconds), MAX_TRACE_CAPTURE_S))
+    rec = _trace.active()
+    owned = rec is None or rec is _trace.fallback()
+    if rec is None:
+        rec = _trace.start()
+    if seconds:
+        time.sleep(seconds)
+    if owned and _trace.active() is rec and rec is not _trace.fallback():
+        _trace.stop()
+    return {
+        "traceEvents": chrome_events(rec),
+        "displayTimeUnit": "ms",
+        "otherData": {"recorded": rec.recorded, "dropped": rec.dropped,
+                      "capture_s": seconds},
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes; the owning StatusServer rides on the server object."""
+
+    server_version = "dmlc-tpu-obs/1"
+
+    def log_message(self, format, *args):  # noqa: A002 — base signature
+        pass  # scrapes must not spam stderr
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, payload: Any, code: int = 200) -> None:
+        self._send(code, json.dumps(payload).encode(),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        try:
+            owner: "StatusServer" = self.server.status_server
+            if url.path == "/metrics":
+                body = render_prometheus(owner.registry.snapshot(),
+                                         owner.registry)
+                self._send(200, body.encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/metrics.json":
+                self._send_json(owner.registry.snapshot())
+            elif url.path == "/healthz":
+                self._send_json(owner.health())
+            elif url.path == "/stacks":
+                self._send(200, _thread_stacks().encode(),
+                           "text/plain; charset=utf-8")
+            elif url.path == "/trace":
+                q = parse_qs(url.query)
+                seconds = float(q.get("seconds", ["1"])[0])
+                self._send_json(_capture_trace(seconds))
+            else:
+                self._send_json({"error": "unknown endpoint",
+                                 "endpoints": ["/metrics",
+                                               "/metrics.json",
+                                               "/healthz", "/stacks",
+                                               "/trace?seconds=N"]},
+                                code=404)
+        except Exception as e:  # noqa: BLE001 — a scrape must never
+            try:                # take down the serving thread
+                self._send_json({"error": repr(e)}, code=500)
+            except Exception:  # noqa: BLE001 — client went away
+                pass
+
+
+class StatusServer:
+    """One daemon-thread HTTP status server for this process."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else REGISTRY
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.status_server = self
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self.started_s = time.time()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dmlc_tpu.obs.StatusServer")
+        self._thread.start()
+        # the port is itself telemetry: a merged gang snapshot tells
+        # the reader where each rank can be curled
+        self.registry.gauge("obs.serve_port").set(self.port)
+
+    def health(self) -> Dict[str, Any]:
+        from dmlc_tpu.obs import trace as _trace
+        from dmlc_tpu.obs import watchdog as _watchdog
+        from dmlc_tpu.obs.metrics import worker_rank
+        wd = _watchdog.active()
+        return {
+            "ok": True,
+            "pid": os.getpid(),
+            "rank": worker_rank(),
+            "uptime_s": round(time.time() - self.started_s, 3),
+            "tracing": _trace.active() is not None,
+            "watchdog": {
+                "installed": wd is not None,
+                "threshold_s": wd.threshold_s if wd else None,
+                "reports": len(wd.reports) if wd else 0,
+            },
+            "waits": _watchdog.current_waits(),
+        }
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "StatusServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_server: Optional[StatusServer] = None
+
+
+def serve(port: int = 0, host: str = "127.0.0.1",
+          registry: Optional[MetricsRegistry] = None) -> StatusServer:
+    """Start the process status server (port 0 = OS-assigned; read
+    ``.port``). One per process: a second call returns the running
+    instance (env/CLI wiring may race module import order)."""
+    global _server
+    if _server is not None:
+        return _server
+    _server = StatusServer(port=port, host=host, registry=registry)
+    return _server
+
+
+def serve_if_env() -> Optional[StatusServer]:
+    """Gang-worker hook (one line, like trace_if_env): start the status
+    server when ``DMLC_TPU_SERVE_PORT`` is set — launch_local's
+    ``serve_ports=...`` sets it per worker — else no-op."""
+    port = os.environ.get(ENV_SERVE_PORT)
+    if not port:
+        return None
+    try:
+        return serve(port=int(port))
+    except (ValueError, OSError) as e:
+        from dmlc_tpu.obs.log import warn_once
+        warn_once("serve-port-failed",
+                  f"obs.serve: could not serve on {ENV_SERVE_PORT}="
+                  f"{port!r}: {e}", all_ranks=True)
+        return None
+
+
+def shutdown() -> None:
+    """Stop the process server started by serve()/serve_if_env()."""
+    global _server
+    srv, _server = _server, None
+    if srv is not None:
+        srv.close()
+
+
+def scrape(port: int, host: str = "127.0.0.1",
+           path: str = "/metrics.json",
+           timeout_s: float = 5.0) -> Dict[str, Any]:
+    """GET one rank's JSON endpoint (stdlib urllib; no deps)."""
+    from urllib.request import urlopen
+    with urlopen(f"http://{host}:{port}{path}",
+                 timeout=timeout_s) as resp:
+        return json.load(resp)
+
+
+def scrape_gang(ports: Optional[List[int]] = None,
+                host: str = "127.0.0.1",
+                timeout_s: float = 5.0) -> Dict[str, Any]:
+    """Scrape every rank's /metrics.json and merge into one gang view
+    (merge_snapshots, keyed by rank). ``ports=None`` reads the gang
+    list from ``DMLC_TPU_SERVE_PORTS`` — so rank 0 INSIDE a
+    launch_local gang can scrape its peers. Unreachable ranks land
+    under ``"unreachable"`` instead of failing the merged read (the
+    rank you cannot scrape is exactly the one you are diagnosing)."""
+    if ports is None:
+        raw = os.environ.get(ENV_SERVE_PORTS, "")
+        ports = [int(p) for p in raw.split(",") if p.strip()]
+    snaps, unreachable = [], {}
+    for port in ports:
+        try:
+            snaps.append(scrape(port, host=host, timeout_s=timeout_s))
+        except Exception as e:  # noqa: BLE001 — dead rank stays visible
+            unreachable[str(port)] = repr(e)
+    merged = merge_snapshots(snaps)
+    if unreachable:
+        merged["unreachable"] = unreachable
+    return merged

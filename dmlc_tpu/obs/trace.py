@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 __all__ = [
     "TraceRecorder", "active", "start", "stop", "trace_to",
     "trace_if_env", "span", "instant", "counter",
+    "set_fallback", "clear_fallback", "fallback",
     "Profiler", "StageStats", "profiler", "jax_trace",
 ]
 
@@ -104,6 +105,28 @@ class TraceRecorder:
         self._events.append(("C", name, cat, time.perf_counter(), 0.0,
                              self._note_thread(), nums))
 
+    # -- recording on behalf of NON-Python threads (the native engine's
+    # span ring drains through here: events carry the engine's own small
+    # thread ids, far below any pthread ident, so tracks never collide)
+
+    def complete_at(self, name: str, t0_s: float, dur_s: float, tid: int,
+                    cat: str = "", args: Optional[dict] = None) -> None:
+        """One finished span attributed to an explicit thread id."""
+        self._count()
+        self._events.append(("X", name, cat, t0_s, dur_s, int(tid), args))
+
+    def instant_at(self, name: str, t_s: float, tid: int, cat: str = "",
+                   args: Optional[dict] = None) -> None:
+        """One instant event attributed to an explicit thread id."""
+        self._count()
+        self._events.append(("i", name, cat, t_s, 0.0, int(tid), args))
+
+    def name_thread(self, tid: int, name: str) -> None:
+        """Register a display name for an explicit thread id (first
+        registration wins, matching _note_thread's behavior)."""
+        with self._lock:
+            self._threads.setdefault(int(tid), name)
+
     # -- reading
 
     @property
@@ -125,6 +148,11 @@ class TraceRecorder:
 # module-global active recorder: None = tracing off. Hot paths read
 # this ONCE per operation (`rec = active()`); everything else no-ops.
 _recorder: Optional[TraceRecorder] = None
+# the always-on FALLBACK ring (obs.flight installs its small crash
+# ring here): it serves as the active recorder whenever no explicit
+# trace is running, so instrumented sites still read ONE global —
+# start() displaces it for the explicit trace, stop() restores it
+_fallback: Optional[TraceRecorder] = None
 
 
 def active() -> Optional[TraceRecorder]:
@@ -132,13 +160,53 @@ def active() -> Optional[TraceRecorder]:
     return _recorder
 
 
+def fallback() -> Optional[TraceRecorder]:
+    """The installed always-on fallback ring (obs.flight), if any."""
+    return _fallback
+
+
+def _sync_native(on: bool) -> None:
+    """Mirror the Python tracing on/off global into the native engine's
+    span-ring flag — only when the engine library is ALREADY loaded
+    (tracing must never trigger a native build/load)."""
+    try:
+        from dmlc_tpu.native import bindings
+        if bindings._lib is not None:
+            bindings._lib.dtp_trace_set_enabled(1 if on else 0)
+    except Exception:  # noqa: BLE001 — telemetry must not raise
+        pass
+
+
+def set_fallback(rec: TraceRecorder) -> None:
+    """Install ``rec`` as the always-on fallback ring. It becomes the
+    active recorder immediately unless an explicit trace is running
+    (that trace keeps recording; ``rec`` takes over at its stop())."""
+    global _recorder, _fallback
+    if _recorder is None or _recorder is _fallback:
+        _recorder = rec
+    _fallback = rec
+    _sync_native(_recorder is not None)
+
+
+def clear_fallback() -> Optional[TraceRecorder]:
+    """Remove the fallback ring (obs.flight uninstall); returns it."""
+    global _recorder, _fallback
+    rec, _fallback = _fallback, None
+    if _recorder is rec:
+        _recorder = None
+    _sync_native(_recorder is not None)
+    return rec
+
+
 def start(capacity: int = 1 << 20) -> TraceRecorder:
     """Install a fresh global recorder. Replacing a live one discards
     everything it held — say so, because the outer ``trace_to`` will
     then skip its export and the silent combination reads as "the
-    trace was empty" instead of "two tracers fought"."""
+    trace was empty" instead of "two tracers fought". (Displacing the
+    always-on fallback ring is the designed interplay, not a fight:
+    no warning, and stop() reinstates it.)"""
     global _recorder
-    if _recorder is not None:
+    if _recorder is not None and _recorder is not _fallback:
         from dmlc_tpu.obs.log import warn_limited
         warn_limited(
             "trace-recorder-replaced",
@@ -148,13 +216,21 @@ def start(capacity: int = 1 << 20) -> TraceRecorder:
             "scopes, don't overlap them", min_interval_s=60.0,
             all_ranks=True)
     _recorder = TraceRecorder(capacity)
+    _sync_native(True)
     return _recorder
 
 
 def stop() -> Optional[TraceRecorder]:
-    """Uninstall and return the active recorder."""
+    """Uninstall and return the active EXPLICIT recorder, reinstating
+    the always-on fallback ring (if one is installed). When only the
+    fallback is active it stays installed and None is returned — use
+    :func:`clear_fallback` to take it down."""
     global _recorder
-    rec, _recorder = _recorder, None
+    rec = _recorder
+    if rec is None or rec is _fallback:
+        return None
+    _recorder = _fallback
+    _sync_native(_recorder is not None)
     return rec
 
 

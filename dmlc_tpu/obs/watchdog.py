@@ -28,17 +28,43 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-__all__ = ["Watchdog", "begin_wait", "end_wait", "active"]
+__all__ = ["Watchdog", "begin_wait", "end_wait", "active",
+           "current_waits", "set_escalation"]
 
 _lock = threading.Lock()
 _seq = itertools.count(1)
 # key -> (name, t0_perf, detail_fn, thread_name)
 _waits: Dict[int, tuple] = {}
 _active: Optional["Watchdog"] = None
+# stall-escalation hook (obs.flight): called with every delivered
+# report, AFTER the per-watchdog on_stall callback — the flight
+# recorder uses it to dump a post-mortem bundle when a run wedges
+_escalation: Optional[Callable[[Dict[str, Any]], None]] = None
 
 
 def active() -> Optional["Watchdog"]:
     return _active
+
+
+def set_escalation(
+        fn: Optional[Callable[[Dict[str, Any]], None]]) -> None:
+    """Install (or clear, with None) the process-wide stall-escalation
+    hook. One hook: the flight recorder owns it when installed."""
+    global _escalation
+    _escalation = fn
+
+
+def current_waits() -> List[Dict[str, Any]]:
+    """The instrumented pulls blocked RIGHT NOW (name, seconds blocked,
+    thread) — the /healthz wait-state surface. Readable with or without
+    a running watchdog (waits only REGISTER while one is installed, so
+    without one this is empty)."""
+    now = time.perf_counter()
+    with _lock:
+        entries = list(_waits.values())
+    return [{"name": name, "blocked_s": round(now - t0, 3),
+             "thread": tname}
+            for name, t0, _fn, tname in entries]
 
 
 def begin_wait(name: str,
@@ -84,12 +110,17 @@ class Watchdog:
                  interval_s: Optional[float] = None,
                  report_path: Optional[str] = None,
                  on_stall: Optional[Callable[[Dict[str, Any]], None]]
-                 = None):
+                 = None, keep_reports: int = 8):
         self.threshold_s = float(threshold_s)
         self.interval_s = (interval_s if interval_s is not None
                            else max(0.05, min(1.0, threshold_s / 4)))
         self.report_path = report_path
         self.on_stall = on_stall
+        # history retention next to report_path: report_path itself
+        # always holds the LATEST report, and each report also lands
+        # as a timestamped sibling — a long soak used to either
+        # overwrite its history (one path) or grow without bound
+        self.keep_reports = max(1, int(keep_reports))
         self.reports: List[Dict[str, Any]] = []
         self._reported: set = set()
         self._stop = threading.Event()
@@ -195,6 +226,7 @@ class Watchdog:
                 with open(tmp, "w") as f:
                     json.dump(report, f, indent=1)
                 os.replace(tmp, self.report_path)
+                self._write_history(report)
                 path_note = f" — report: {self.report_path}"
             except Exception as e:  # noqa: BLE001
                 path_note = f" — report write failed: {e}"
@@ -208,4 +240,33 @@ class Watchdog:
             try:
                 self.on_stall(report)
             except Exception:  # noqa: BLE001 — user callback
+                pass
+        if _escalation is not None:
+            try:
+                _escalation(report)
+            except Exception:  # noqa: BLE001 — escalation hook
+                pass
+
+    def _write_history(self, report: Dict[str, Any]) -> None:
+        """Timestamped sibling of report_path + bounded retention:
+        ``stall.json`` keeps the latest, ``stall.20260803-101502-417.json``
+        (..517, ...) keep the last ``keep_reports`` stalls of a soak."""
+        import glob
+        root, ext = os.path.splitext(self.report_path)
+        ext = ext or ".json"
+        t = report.get("time", time.time())
+        stamp = (time.strftime("%Y%m%d-%H%M%S", time.localtime(t))
+                 + f"-{int(t * 1000) % 1000:03d}")
+        hist = f"{root}.{stamp}{ext}"
+        tmp = hist + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, hist)
+        kept = sorted(p for p in glob.glob(f"{root}.*{ext}")
+                      if p != self.report_path
+                      and not p.endswith(".tmp"))
+        for stale in kept[:-self.keep_reports]:
+            try:
+                os.remove(stale)
+            except OSError:
                 pass

@@ -43,6 +43,12 @@ __all__ = [
 # workers that wrap their run in obs.trace.trace_if_env() export a
 # rank-tagged Chrome trace into this dir (launch_local(trace_dir=...))
 ENV_TRACE_DIR = "DMLC_TPU_TRACE_DIR"
+# live-telemetry env contract (launch_local(serve_ports=...) /
+# launch_local(flight_dir=...)): workers opt in with one call each —
+# obs.serve.serve_if_env() and obs.flight.install_if_env()
+ENV_SERVE_PORT = "DMLC_TPU_SERVE_PORT"    # this worker's status port
+ENV_SERVE_PORTS = "DMLC_TPU_SERVE_PORTS"  # comma-joined gang ports
+ENV_FLIGHT_DIR = "DMLC_TPU_FLIGHT_DIR"    # crash-bundle output dir
 
 # env contract (reference: slave_envs in tracker.py)
 ENV_COORD = "DMLC_TPU_COORDINATOR_URI"
@@ -186,7 +192,9 @@ def launch_local(num_workers: int, command: Sequence[str],
                  coordinator: Optional[str] = None,
                  timeout: Optional[float] = None,
                  num_servers: int = 0,
-                 trace_dir: Optional[str] = None) -> List[int]:
+                 trace_dir: Optional[str] = None,
+                 serve_ports=None,
+                 flight_dir: Optional[str] = None) -> List[int]:
     """Run N worker processes on this host (reference: local.py).
 
     With ``num_servers > 0`` (reference: dmlc-submit --num-servers +
@@ -203,11 +211,35 @@ def launch_local(num_workers: int, command: Sequence[str],
     are merged into ``<trace_dir>/trace-gang.json`` — one Perfetto
     timeline, one process row per rank.
 
+    ``serve_ports`` wires the LIVE telemetry plane (dmlc_tpu.obs.serve):
+    a list of one port per worker (or ``True`` to probe free ones) hands
+    rank *i* ``DMLC_TPU_SERVE_PORT=ports[i]`` — workers that call
+    ``obs.serve.serve_if_env()`` answer /metrics, /healthz, /stacks and
+    /trace WHILE the gang runs — plus the full comma-joined list in
+    ``DMLC_TPU_SERVE_PORTS`` so rank 0 (or anyone) can
+    ``obs.serve.scrape_gang()`` the live processes into one merged
+    snapshot. Pass explicit ports when the launcher itself will scrape.
+
+    ``flight_dir`` hands every worker the crash flight-recorder
+    contract (``DMLC_TPU_FLIGHT_DIR``): workers that call
+    ``obs.flight.install_if_env()`` leave a post-mortem bundle there
+    when they die badly (uncaught exception, fatal signal, confirmed
+    stall) — the black box for the gang member that took everyone down.
+
     Returns the list of exit codes (workers first in task-id order,
     then scheduler, then servers). Raises if any process fails.
     """
     check(num_workers >= 1, "num_workers must be >= 1")
     check(num_servers >= 0, "num_servers must be >= 0")
+    if serve_ports is True:
+        serve_ports = find_free_ports(num_workers)
+    if serve_ports is not None:
+        serve_ports = [int(p) for p in serve_ports]
+        check(len(serve_ports) == num_workers,
+              f"serve_ports needs one port per worker "
+              f"({len(serve_ports)} != {num_workers})")
+    if flight_dir is not None:
+        os.makedirs(flight_dir, exist_ok=True)
     if trace_dir is not None:
         import glob
         os.makedirs(trace_dir, exist_ok=True)
@@ -255,6 +287,11 @@ def launch_local(num_workers: int, command: Sequence[str],
             wenv.update(worker_envs(coordinator, num_workers, task_id))
             if trace_dir is not None:
                 wenv[ENV_TRACE_DIR] = trace_dir
+            if serve_ports is not None:
+                wenv[ENV_SERVE_PORT] = str(serve_ports[task_id])
+                wenv[ENV_SERVE_PORTS] = ",".join(map(str, serve_ports))
+            if flight_dir is not None:
+                wenv[ENV_FLIGHT_DIR] = flight_dir
             if ps_root is not None:
                 wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
                                     num_servers, "worker", task_id))

@@ -227,6 +227,15 @@ class _ParseRunner(_RunnerBase):
                 _trace.counter("engine", engine, "native")
             except Exception:  # noqa: BLE001 — telemetry must not kill
                 pass
+        rec = _trace.active()
+        drain = getattr(self._parser, "drain_trace", None)
+        if rec is not None and drain is not None:
+            # the engine's span ring (chunk read/tokenize/assemble/
+            # cache events) joins the Python spans on ONE timeline
+            try:
+                drain(rec)
+            except Exception:  # noqa: BLE001 — telemetry must not kill
+                pass
         try:
             self.probe.extra["bytes_read"] = int(self._parser.bytes_read())
         except Exception:  # noqa: BLE001

@@ -13,6 +13,13 @@ without the tools baked in:
   telemetry registers into ``dmlc_tpu.obs.metrics`` and logs through
   ``dmlc_tpu.obs.log``. Pre-obs surfaces are pinned in an allowlist;
   the list shrinks, it does not grow.
+- **Metric-name gate** (always run, AST-based): every literal
+  instrument name passed to ``.counter("...")``/``.gauge("...")``/
+  ``.histogram("...")`` inside ``dmlc_tpu/`` must match
+  ``[a-z0-9_.]+`` — anything else renders badly (or not at all) in
+  the Prometheus exposition that ``obs/serve.py`` derives from the
+  registry. And ``http.server`` may be used ONLY by ``obs/serve.py``:
+  one status server per process, not one per module.
 - **ruff** over the Python tree and **clang-format --dry-run -Werror**
   over native/src/ — run when the binaries are importable/installed,
   reported as skipped otherwise.
@@ -26,6 +33,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import subprocess
 import sys
 from typing import List, Optional
@@ -107,19 +115,36 @@ STATS_ALLOWED = {
 }
 
 
-def obs_lint(paths: List[str]) -> List[str]:
-    """The observability gate: no new bare print()/ad-hoc stats() dict
-    shapes inside dmlc_tpu/ outside obs/ (see module docstring)."""
-    findings: List[str] = []
+def _parse_package_trees(paths: List[str]) -> dict:
+    """{path: (rel, ast)} for the dmlc_tpu/ files — parsed ONCE and
+    shared by every AST gate (each gate re-parsing the tree tripled
+    the lint cost per added gate). Unparseable files are absent;
+    builtin_lint reports those."""
+    trees = {}
     for path in paths:
         rel = os.path.relpath(path, REPO).replace(os.sep, "/")
-        if not rel.startswith("dmlc_tpu/") or rel.startswith("dmlc_tpu/obs/"):
+        if not rel.startswith("dmlc_tpu/"):
             continue
         try:
             with open(path, "r", encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=rel)
+                trees[path] = (rel, ast.parse(f.read(), filename=rel))
         except (OSError, SyntaxError, UnicodeDecodeError):
-            continue  # builtin_lint already reports these
+            pass
+    return trees
+
+
+def obs_lint(paths: List[str], trees: Optional[dict] = None) -> List[str]:
+    """The observability gate: no new bare print()/ad-hoc stats() dict
+    shapes inside dmlc_tpu/ outside obs/ (see module docstring)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel.startswith("dmlc_tpu/obs/"):
+            continue
         for node in ast.walk(tree):
             if (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Name)
@@ -135,6 +160,57 @@ def obs_lint(paths: List[str]) -> List[str]:
                     f"{rel}:{node.lineno}: new stats() surface — "
                     "register a collector with dmlc_tpu.obs.metrics."
                     "REGISTRY instead of inventing a dict shape")
+    return findings
+
+
+# registry instrument names must survive the Prometheus name mangling
+# in obs/serve.py losslessly: lowercase words joined by '.' (or '_')
+METRIC_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
+# the ONE module allowed to stand up an HTTP server (package-relative)
+HTTP_SERVER_ALLOWED = {"dmlc_tpu/obs/serve.py"}
+_INSTRUMENT_METHODS = ("counter", "gauge", "histogram")
+
+
+def metric_lint(paths: List[str],
+                trees: Optional[dict] = None) -> List[str]:
+    """The metric-name + http.server gate (see module docstring).
+    Literal names only: f-string/dynamic names are built from literal
+    parts that the regex already vets at their other call sites."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _INSTRUMENT_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                name = node.args[0].value
+                if not METRIC_NAME_RE.match(name):
+                    findings.append(
+                        f"{rel}:{node.lineno}: metric name {name!r} — "
+                        "registry instrument names must match "
+                        "[a-z0-9_.]+ (Prometheus exposition contract, "
+                        "obs/serve.py)")
+            if rel in HTTP_SERVER_ALLOWED:
+                continue
+            if (isinstance(node, ast.Import)
+                    and any(a.name == "http.server"
+                            for a in node.names)) or \
+               (isinstance(node, ast.ImportFrom)
+                    and (node.module == "http.server"
+                         or (node.module == "http"
+                             and any(a.name == "server"
+                                     for a in node.names)))):
+                findings.append(
+                    f"{rel}:{node.lineno}: http.server outside "
+                    "obs/serve.py — the process status server lives "
+                    "there (serve()/serve_if_env()), one per process")
     return findings
 
 
@@ -178,7 +254,9 @@ def run_clang_format(root: str = NATIVE_SRC) -> Optional[List[str]]:
 def main() -> int:
     paths = python_files()
     findings = builtin_lint(paths)
-    findings += obs_lint(paths)
+    trees = _parse_package_trees(paths)  # one parse, both AST gates
+    findings += obs_lint(paths, trees)
+    findings += metric_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

@@ -67,3 +67,29 @@ class TestLintGate:
         kinds = "\n".join(findings)
         assert "bare print()" in kinds
         assert "new stats() surface" in kinds
+
+    def test_metric_gate_clean(self):
+        # every literal instrument name in dmlc_tpu/ is exposition-safe
+        # and no module outside obs/serve.py stands up an http.server
+        findings = lint.metric_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_metric_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe.py")
+        with open(bad, "w") as f:
+            f.write("from http.server import HTTPServer\n"
+                    "from dmlc_tpu.obs.metrics import REGISTRY\n"
+                    "REGISTRY.counter('Bad Name!').inc()\n"
+                    "REGISTRY.gauge('ok.name').set(1)\n")
+        try:
+            findings = lint.metric_lint([bad])
+        finally:
+            os.remove(bad)
+        kinds = "\n".join(findings)
+        assert "metric name 'Bad Name!'" in kinds
+        assert "http.server outside" in kinds
+        assert "ok.name" not in kinds  # the clean name passes
+
+    def test_metric_gate_allows_serve_module(self):
+        serve = os.path.join(lint.REPO, "dmlc_tpu", "obs", "serve.py")
+        assert lint.metric_lint([serve]) == []

@@ -1,0 +1,627 @@
+"""Live telemetry plane + flight recorder + native span ring (PR 4).
+
+Covers: the Prometheus exposition golden format (names/types/HELP
+lines pinned), the status server endpoints (scrape-under-load: the
+server answers while a pipeline loop runs), a REAL 2-process
+launch_local gang serving per-rank /metrics + /healthz during the run,
+a provoked subprocess crash leaving a flight-recorder bundle whose
+trace file passes the Perfetto golden-key check, native-engine spans
+merging consistently onto the Python timeline, watchdog report
+timestamping/retention, and warn-channel instants on the trace.
+"""
+
+import glob
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dmlc_tpu.obs import flight as obs_flight
+from dmlc_tpu.obs import log as obs_log
+from dmlc_tpu.obs import trace as obs_trace
+from dmlc_tpu.obs import watchdog as obs_watchdog
+from dmlc_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from dmlc_tpu.obs.serve import (
+    StatusServer, render_prometheus, scrape, scrape_gang,
+)
+from dmlc_tpu.obs.watchdog import Watchdog
+
+CHROME_REQUIRED_KEYS = ("ph", "ts", "pid", "tid", "name")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """Tracing off, no flight recorder, fresh log state per test."""
+    obs_flight.uninstall()
+    obs_trace.stop()
+    obs_trace.clear_fallback()
+    obs_log.reset()
+    yield
+    obs_flight.uninstall()
+    obs_trace.stop()
+    obs_trace.clear_fallback()
+    obs_log.reset()
+
+
+def _write_libsvm(tmp_path, rows=600, name="live.libsvm"):
+    lines = [f"{i % 2} 1:0.5 7:1.25 9:{i}.0" for i in range(rows)]
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _get(url: str, timeout_s: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.status, resp.read()
+
+
+def _assert_chrome_golden(doc):
+    assert "traceEvents" in doc and doc["traceEvents"]
+    for ev in doc["traceEvents"]:
+        for key in CHROME_REQUIRED_KEYS:
+            assert key in ev, (key, ev)
+    for ev in doc["traceEvents"]:
+        if ev["ph"] == "X":
+            assert "dur" in ev, ev
+
+
+class TestPrometheusExposition:
+    def _registry(self):
+        reg = MetricsRegistry()
+        reg.counter("rows.parsed").inc(5)
+        reg.gauge("queue.depth").set(3)
+        reg.gauge("replay.tier").set("pages")
+        reg.gauge("never.set")  # value None: silently absent
+        # a structured gauge value has no single exposition line ->
+        # skipped + counted (snapshot() reprs plain objects to str,
+        # which renders info-style; dicts/lists pass through)
+        reg.gauge("weird.object").set({"structured": 1})
+        reg.histogram("wait.s").observe(0.25)
+        reg.histogram("wait.s").observe(0.5)
+        return reg
+
+    def test_golden_families(self):
+        """Golden: family names, TYPE lines, HELP lines, and value
+        lines of the exposition are pinned — a renderer change must
+        change this test consciously."""
+        reg = self._registry()
+
+        class Surface:
+            def stats(self):
+                return {"qsize": 2, "note": "text", "nested": {"n": 7}}
+
+        s = Surface()
+        reg.register("queue/demo", s, Surface.stats)
+        text = render_prometheus(reg.snapshot(), reg)
+        assert text.endswith("\n")
+        # identity series
+        assert "# TYPE dmlc_obs_info gauge" in text
+        assert 'dmlc_obs_info{rank="None"' in text
+        # counter family
+        assert "# HELP dmlc_rows_parsed_total Counter rows.parsed" \
+            in text
+        assert "# TYPE dmlc_rows_parsed_total counter" in text
+        assert "\ndmlc_rows_parsed_total 5\n" in text
+        # numeric gauge
+        assert "# TYPE dmlc_queue_depth gauge" in text
+        assert "\ndmlc_queue_depth 3\n" in text
+        # string gauge -> info-style labeled series, NOT a bare repr
+        assert 'dmlc_replay_tier_info{value="pages"} 1' in text
+        assert "dmlc_replay_tier pages" not in text
+        # non-renderable gauge -> counted, not emitted
+        assert "dmlc_weird_object" not in text
+        assert "# TYPE dmlc_obs_export_skipped_total counter" in text
+        assert "\ndmlc_obs_export_skipped_total 1\n" in text
+        # histogram: cumulative buckets + sum/count
+        assert "# TYPE dmlc_wait_s histogram" in text
+        assert 'dmlc_wait_s_bucket{le="+Inf"} 2' in text
+        assert "\ndmlc_wait_s_count 2\n" in text
+        assert "\ndmlc_wait_s_sum 0.75\n" in text
+        # collector numeric leaves, flattened + labeled; strings dropped
+        assert ('dmlc_collector_value{collector="queue/demo",'
+                'key="qsize"} 2') in text
+        assert ('dmlc_collector_value{collector="queue/demo",'
+                'key="nested.n"} 7') in text
+        assert "note" not in text
+
+    def test_every_line_is_valid_exposition(self):
+        import re
+        reg = self._registry()
+        text = render_prometheus(reg.snapshot(), reg)
+        line_re = re.compile(
+            r"^[a-z_][a-z0-9_]*(\{[^{}]*\})? -?[0-9.eE+-]+$")
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert line_re.match(line), line
+
+    def test_skipped_counter_accumulates(self):
+        reg = self._registry()
+        render_prometheus(reg.snapshot(), reg)
+        text = render_prometheus(reg.snapshot(), reg)
+        # two renders of one bad gauge -> 2 (monotonic counter), and
+        # the family appears exactly ONCE even though the counter now
+        # also lives in the snapshot (a duplicate family fails the
+        # whole scrape under promtool)
+        assert "\ndmlc_obs_export_skipped_total 2\n" in text
+        assert text.count("# TYPE dmlc_obs_export_skipped_total") == 1
+        assert text.count("dmlc_obs_export_skipped_total 2") == 1
+
+
+class TestStatusServer:
+    def test_endpoints(self):
+        reg = MetricsRegistry()
+        reg.counter("srv.hits").inc(7)
+        reg.gauge("srv.tier").set("memory")
+        with StatusServer(registry=reg) as srv:
+            status, body = _get(srv.url("/metrics"))
+            assert status == 200
+            assert b"dmlc_srv_hits_total 7" in body
+            assert b'dmlc_srv_tier_info{value="memory"} 1' in body
+            status, body = _get(srv.url("/metrics.json"))
+            snap = json.loads(body)
+            assert snap["schema"] == 1
+            assert snap["counters"]["srv.hits"] == 7
+            status, body = _get(srv.url("/healthz"))
+            health = json.loads(body)
+            assert health["ok"] is True
+            assert health["pid"] == os.getpid()
+            assert health["watchdog"]["installed"] is False
+            assert health["waits"] == []
+            status, body = _get(srv.url("/stacks"))
+            assert status == 200 and b"Thread" in body
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(srv.url("/nope"))
+            assert e.value.code == 404
+
+    def test_healthz_reports_blocked_waits(self):
+        """The liveness endpoint names the pull that is wedged RIGHT
+        NOW — the 'curl the slow rank' story."""
+        with StatusServer() as srv:
+            wd = Watchdog(threshold_s=60, interval_s=10).start()
+            try:
+                token = obs_watchdog.begin_wait("pull/wedged.demo")
+                time.sleep(0.02)
+                health = json.loads(_get(srv.url("/healthz"))[1])
+                names = [w["name"] for w in health["waits"]]
+                assert "pull/wedged.demo" in names
+                assert health["watchdog"]["installed"] is True
+                obs_watchdog.end_wait(token)
+                health = json.loads(_get(srv.url("/healthz"))[1])
+                assert health["waits"] == []
+            finally:
+                wd.stop()
+
+    def test_scrape_under_load(self, tmp_path):
+        """The server answers /metrics while a real pipeline loop runs
+        in this process (the bench-loop shape)."""
+        from dmlc_tpu.pipeline import Pipeline
+        uri = _write_libsvm(tmp_path, rows=3000)
+        built = (Pipeline.from_uri(uri)
+                 .parse(format="libsvm", engine="python",
+                        chunk_size=2048)
+                 .batch(128)
+                 .build())
+        stop = threading.Event()
+        errors = []
+
+        def pump():
+            try:
+                while not stop.is_set():
+                    for _ in built:
+                        pass
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=pump, daemon=True)
+        with StatusServer() as srv:
+            t.start()
+            try:
+                ok = 0
+                deadline = time.time() + 10.0
+                while ok < 20 and time.time() < deadline:
+                    status, body = _get(srv.url("/metrics"))
+                    assert status == 200
+                    assert body.startswith(b"# HELP dmlc_obs_info")
+                    snap = json.loads(_get(srv.url("/metrics.json"))[1])
+                    assert snap["schema"] == 1
+                    ok += 1
+                assert ok >= 20
+            finally:
+                stop.set()
+                t.join(timeout=10.0)
+        # the pipeline's collector is visible in the scraped registry
+        # (collision-suffixed when earlier tests registered one too)
+        assert any(k.startswith("pipeline")
+                   for k in REGISTRY.snapshot()["collectors"])
+        built.close()
+        assert errors == []
+
+    def test_trace_capture_of_running_recorder(self):
+        rec = obs_trace.start()
+        with obs_trace.span("live-work"):
+            pass
+        try:
+            with StatusServer() as srv:
+                doc = json.loads(
+                    _get(srv.url("/trace?seconds=0"))[1])
+                _assert_chrome_golden(doc)
+                assert any(e.get("name") == "live-work"
+                           for e in doc["traceEvents"])
+            # the running trace was NOT disturbed by the capture
+            assert obs_trace.active() is rec
+        finally:
+            obs_trace.stop()
+
+    def test_trace_capture_installs_when_off(self):
+        assert obs_trace.active() is None
+        with StatusServer() as srv:
+            doc = json.loads(_get(srv.url("/trace?seconds=0.05"))[1])
+            assert "traceEvents" in doc
+            assert doc["otherData"]["capture_s"] == 0.05
+        # the on-demand recorder was uninstalled after the window
+        assert obs_trace.active() is None
+
+
+class TestGangServe:
+    """Acceptance: a REAL 2-process launch_local gang serves scrapeable
+    per-rank /metrics and /healthz DURING the run."""
+
+    def test_two_process_gang_scraped_live(self, tmp_path):
+        from dmlc_tpu.parallel.launch import find_free_ports, launch_local
+        script = tmp_path / "serve_worker.py"
+        stop_file = tmp_path / "stop"
+        script.write_text(
+            "import os, sys, time\n"
+            "from dmlc_tpu.obs.serve import serve_if_env\n"
+            "from dmlc_tpu.obs.metrics import REGISTRY\n"
+            "srv = serve_if_env()\n"
+            "assert srv is not None, 'serve port env missing'\n"
+            "rank = int(os.environ['DMLC_TPU_TASK_ID'])\n"
+            "REGISTRY.counter('gang.rows').inc(100 * (rank + 1))\n"
+            "REGISTRY.gauge('gang.tier').set('pages')\n"
+            "deadline = time.time() + 30\n"
+            "while not os.path.exists(sys.argv[1]) "
+            "and time.time() < deadline:\n"
+            "    time.sleep(0.05)\n"
+        )
+        ports = find_free_ports(2)
+        env = {"PYTHONPATH": os.pathsep.join(
+            [REPO] + os.environ.get("PYTHONPATH", "").split(os.pathsep))}
+        result = {}
+
+        def gang():
+            try:
+                result["codes"] = launch_local(
+                    2, [sys.executable, str(script), str(stop_file)],
+                    env=env, serve_ports=ports, timeout=60)
+            except Exception as e:  # noqa: BLE001
+                result["error"] = e
+
+        t = threading.Thread(target=gang, daemon=True)
+        t.start()
+        try:
+            # poll until BOTH ranks answer /healthz — they are alive
+            # and serving WHILE the gang runs
+            deadline = time.time() + 30.0
+            healthy = {}
+            while len(healthy) < 2 and time.time() < deadline:
+                for rank, port in enumerate(ports):
+                    if rank in healthy:
+                        continue
+                    try:
+                        h = scrape(port, path="/healthz",
+                                   timeout_s=2.0)
+                        assert h["ok"] is True
+                        assert h["rank"] == rank
+                        healthy[rank] = h
+                    except (OSError, urllib.error.URLError):
+                        time.sleep(0.05)
+            assert len(healthy) == 2, f"gang never served: {result}"
+            # per-rank Prometheus exposition is live
+            status, body = _get(f"http://127.0.0.1:{ports[0]}/metrics")
+            assert status == 200 and b"dmlc_gang_rows_total 100" in body
+            status, body = _get(f"http://127.0.0.1:{ports[1]}/metrics")
+            assert status == 200 and b"dmlc_gang_rows_total 200" in body
+            # rank-0-style merged scrape of the whole gang
+            merged = scrape_gang(ports)
+            assert set(merged["workers"]) == {"rank0", "rank1"}
+            assert merged["workers"]["rank1"]["counters"]["gang.rows"] \
+                == 200
+            assert "unreachable" not in merged
+        finally:
+            stop_file.write_text("stop")
+            t.join(timeout=30.0)
+        assert result.get("codes") == [0, 0], result
+
+
+class TestFlightRecorder:
+    def test_fallback_ring_interplay(self):
+        """The flight ring serves as the active recorder when no
+        explicit trace runs; start() displaces it, stop() reinstates
+        it; clear_fallback() removes it."""
+        ring = obs_trace.TraceRecorder(100)
+        obs_trace.set_fallback(ring)
+        assert obs_trace.active() is ring
+        obs_trace.instant("background-event")
+        assert ring.recorded == 1
+        rec = obs_trace.start()  # no replaced-recorder warning path
+        assert obs_trace.active() is rec
+        obs_trace.instant("foreground-event")
+        assert ring.recorded == 1  # explicit trace owns the window
+        assert obs_trace.stop() is rec
+        assert obs_trace.active() is ring
+        assert obs_trace.stop() is None  # fallback not removable here
+        assert obs_trace.active() is ring
+        assert obs_trace.clear_fallback() is ring
+        assert obs_trace.active() is None
+
+    def test_install_dump_uninstall(self, tmp_path):
+        fl = obs_flight.FlightRecorder(
+            out_dir=str(tmp_path / "flight"),
+            metrics_interval_s=0.05).install()
+        try:
+            with obs_trace.span("flight-covered-work"):
+                pass
+            REGISTRY.counter("flight.test_events").inc(3)
+            time.sleep(0.15)  # let the sampler take a history snapshot
+            d = fl.dump("unit_test")
+            assert os.path.isdir(d)
+            manifest = json.load(open(os.path.join(d, "MANIFEST.json")))
+            assert manifest["kind"] == "dmlc_tpu_flight_bundle"
+            assert manifest["reason"] == "unit_test"
+            doc = json.load(open(os.path.join(d, "trace.json")))
+            _assert_chrome_golden(doc)
+            assert any(e.get("name") == "flight-covered-work"
+                       for e in doc["traceEvents"])
+            metrics = json.load(open(os.path.join(d, "metrics.json")))
+            assert metrics["current"]["counters"][
+                "flight.test_events"] == 3
+            assert len(metrics["history"]) >= 1
+            stacks = open(os.path.join(d, "stacks.txt")).read()
+            assert "Thread" in stacks
+            env = json.load(open(os.path.join(d, "env.json")))
+            assert env["argv"]
+        finally:
+            fl.uninstall()
+        assert obs_trace.active() is None
+
+    def test_clean_uninstall_leaves_no_bundle(self, tmp_path):
+        out = str(tmp_path / "flight")
+        fl = obs_flight.FlightRecorder(out_dir=out).install()
+        fl.uninstall()
+        assert glob.glob(os.path.join(out, "flight-*")) == []
+
+    def test_worker_crash_leaves_loadable_bundle(self, tmp_path):
+        """Acceptance: a provoked launch_local worker crash leaves a
+        flight-recorder bundle whose trace file passes the Perfetto
+        golden-key check — the flight_dir env wiring end to end."""
+        from dmlc_tpu.parallel.launch import launch_local
+        from dmlc_tpu.utils.logging import DMLCError
+        out = str(tmp_path / "flight")
+        script = tmp_path / "crash.py"
+        script.write_text(
+            "from dmlc_tpu.obs.flight import install_if_env\n"
+            "fl = install_if_env()\n"
+            "assert fl is not None\n"
+            "from dmlc_tpu.obs.metrics import REGISTRY\n"
+            "from dmlc_tpu.obs.trace import span\n"
+            "REGISTRY.counter('doomed.rows').inc(42)\n"
+            "with span('doomed-work'):\n"
+            "    pass\n"
+            "raise RuntimeError('deliberate flight-recorder crash')\n"
+        )
+        env = {"PYTHONPATH": os.pathsep.join(
+            [REPO] + os.environ.get("PYTHONPATH", "").split(os.pathsep))}
+        with pytest.raises(DMLCError):
+            launch_local(1, [sys.executable, str(script)], env=env,
+                         flight_dir=out, timeout=120)
+        bundles = glob.glob(os.path.join(out, "flight-*"))
+        assert len(bundles) == 1, bundles
+        d = bundles[0]
+        manifest = json.load(open(os.path.join(d, "MANIFEST.json")))
+        assert manifest["reason"] == "uncaught_exception"
+        doc = json.load(open(os.path.join(d, "trace.json")))
+        _assert_chrome_golden(doc)  # Perfetto-loadable golden keys
+        assert any(e.get("name") == "doomed-work"
+                   for e in doc["traceEvents"])
+        metrics = json.load(open(os.path.join(d, "metrics.json")))
+        assert metrics["current"]["counters"]["doomed.rows"] == 42
+        error = open(os.path.join(d, "error.txt")).read()
+        assert "deliberate flight-recorder crash" in error
+        assert "Thread" in open(os.path.join(d, "stacks.txt")).read()
+
+    def test_watchdog_escalation_dumps_bundle(self, tmp_path):
+        """A watchdog-confirmed stall dumps a bundle while the process
+        is still alive (kill -9 comes later; the bundle survives)."""
+        from dmlc_tpu.data.threaded_iter import ThreadedIter
+        fl = obs_flight.FlightRecorder(
+            out_dir=str(tmp_path / "flight")).install()
+        release = threading.Event()
+        ti = ThreadedIter(max_capacity=2, name="flight.stalled")
+        ti.init(lambda: (release.wait(30.0), None)[1])
+        consumer = threading.Thread(target=ti.next, daemon=True)
+        try:
+            with Watchdog(threshold_s=0.15, interval_s=0.05) as wd:
+                consumer.start()
+                deadline = time.time() + 5.0
+                while not wd.reports and time.time() < deadline:
+                    time.sleep(0.02)
+            assert fl.dumped, "escalation never dumped"
+            wdj = json.load(open(os.path.join(
+                fl.bundle_dir, "watchdog.json")))
+            blocked = wdj["escalating_report"]["blocked"]
+            assert any("flight.stalled" in b["name"] for b in blocked)
+            manifest = json.load(open(os.path.join(
+                fl.bundle_dir, "MANIFEST.json")))
+            assert manifest["reason"] == "watchdog_stall"
+        finally:
+            release.set()
+            consumer.join(timeout=5.0)
+            ti.destroy()
+            fl.uninstall()
+
+
+class TestWatchdogReportRetention:
+    def test_timestamped_history_bounded(self, tmp_path):
+        """Satellite: each stall report lands under a timestamped name
+        next to report_path (which keeps the latest), and only the
+        last keep_reports survive a soak."""
+        report_path = str(tmp_path / "stall.json")
+        wd = Watchdog(threshold_s=0.02, interval_s=999,
+                      report_path=report_path, keep_reports=2).start()
+        try:
+            for i in range(4):
+                token = obs_watchdog.begin_wait(f"soak.{i}")
+                time.sleep(0.03)
+                report = wd.check()
+                assert report is not None, f"stall {i} unreported"
+                obs_watchdog.end_wait(token)
+                time.sleep(0.002)  # distinct ms timestamps
+        finally:
+            wd.stop()
+        assert os.path.exists(report_path)
+        latest = json.load(open(report_path))
+        assert latest["blocked"][0]["name"] == "soak.3"
+        history = sorted(glob.glob(str(tmp_path / "stall.*.json")))
+        assert len(history) == 2, history  # keep_reports=2 pruned 4->2
+        names = [json.load(open(p))["blocked"][0]["name"]
+                 for p in history]
+        assert names == ["soak.2", "soak.3"]  # the LAST two survive
+
+
+class TestWarnInstants:
+    def _capture(self):
+        from dmlc_tpu.utils.logging import set_log_sink
+        hits = []
+        set_log_sink(lambda lvl, msg: hits.append(msg))
+        return hits
+
+    def _restore(self):
+        from dmlc_tpu.utils.logging import set_log_sink
+        set_log_sink(None)
+
+    def test_emitted_warning_lands_on_timeline(self):
+        hits = self._capture()
+        rec = obs_trace.start()
+        try:
+            assert obs_log.warn_once("spill-degrade",
+                                     "spill failed; replay off")
+            # suppressed repeat adds NO second instant
+            assert not obs_log.warn_once("spill-degrade", "again")
+        finally:
+            obs_trace.stop()
+            self._restore()
+        warns = [e for e in rec.events()
+                 if e[0] == "i" and e[1] == "warn/spill-degrade"]
+        assert len(warns) == 1
+        assert warns[0][6] == {"msg": "spill failed; replay off"}
+        assert warns[0][2] == "log"
+        assert hits == ["spill failed; replay off"]
+
+    def test_no_recorder_no_cost(self):
+        hits = self._capture()
+        try:
+            assert obs_log.warn_once("quiet-key", "no recorder")
+        finally:
+            self._restore()
+        assert hits == ["no recorder"]
+
+
+def _native_available():
+    from dmlc_tpu import native
+    return native.native_available()
+
+
+class TestNativeSpanRing:
+    """The engine's span ring merges onto the Python timeline."""
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        if not _native_available():
+            pytest.skip("native engine unavailable on this host")
+
+    def test_ring_off_by_default(self, tmp_path):
+        from dmlc_tpu.native.bindings import NativeLibSVMParser, _get_lib
+        assert obs_trace.active() is None
+        assert _get_lib().dtp_trace_enabled() == 0
+        p = NativeLibSVMParser(_write_libsvm(tmp_path), 0, 1,
+                               chunk_size=2048)
+        while p.next():
+            pass
+        rec = obs_trace.TraceRecorder(100)
+        assert p.drain_trace(rec) == 0  # nothing recorded while off
+        p.destroy()
+
+    def test_spans_merge_consistently(self, tmp_path):
+        """Drained native spans agree with the engine's own counters
+        (tokenize spans == chunks, assemble spans == delivered blocks)
+        and land inside the run's perf_counter window after the
+        drain-time clock calibration."""
+        from dmlc_tpu.native.bindings import NativeLibSVMParser
+        uri = _write_libsvm(tmp_path, rows=5000)
+        rec = obs_trace.start()
+        try:
+            t_begin = time.perf_counter()
+            p = NativeLibSVMParser(uri, 0, 1, chunk_size=4096)
+            blocks = 0
+            while p.next():
+                blocks += 1
+            n = p.drain_trace(rec)
+            t_end = time.perf_counter()
+            chunks = p.stats()["chunks"]
+            p.destroy()
+        finally:
+            obs_trace.stop()
+        assert n > 0 and blocks > 0
+        by_name = {}
+        for ph, name, cat, t0, dur, tid, args in rec.events():
+            if cat != "native":
+                continue
+            by_name.setdefault(name, []).append((t0, dur, tid))
+            assert t_begin <= t0 <= t_end, (name, t0, t_begin, t_end)
+            assert t0 + dur <= t_end + 0.001
+        assert len(by_name["native/tokenize"]) == chunks
+        assert len(by_name["native/chunk_read"]) == chunks
+        assert len(by_name["native/batch_assemble"]) == blocks
+        # arena events exist and classify every tokenize
+        cache = (len(by_name.get("native/cache.hit", []))
+                 + len(by_name.get("native/cache.miss", [])))
+        assert cache == chunks
+        # native tracks are disjoint from Python thread idents and are
+        # named in the chrome export
+        from dmlc_tpu.obs.export import chrome_events
+        evs = chrome_events(rec)
+        names = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert "native/reader" in names
+        assert any(n.startswith("native/worker-") for n in names)
+
+    def test_pipeline_trace_includes_native_spans(self, tmp_path):
+        """End to end: CompiledPipeline.trace() over the native engine
+        puts engine spans and Python pull spans in ONE loadable file."""
+        from dmlc_tpu.pipeline import Pipeline
+        uri = _write_libsvm(tmp_path, rows=5000)
+        built = (Pipeline.from_uri(uri)
+                 .parse(format="libsvm", engine="native",
+                        chunk_size=4096)
+                 .build())
+        path = str(tmp_path / "merged.json")
+        with built.trace(path):
+            for _ in built:
+                pass
+        built.close()
+        doc = json.load(open(path))
+        _assert_chrome_golden(doc)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "pull/parse" in names          # the Python span...
+        assert "native/tokenize" in names     # ...and the engine span
+        assert "native/chunk_read" in names   # on one timeline
+        # the flag mirrors back off with tracing stopped
+        from dmlc_tpu.native.bindings import _get_lib
+        assert _get_lib().dtp_trace_enabled() == 0
