@@ -17,6 +17,10 @@ Re-designed (not ported) from the reference `trivialfis/dmlc-core`:
 - ``dmlc_tpu.obs``      — unified observability: trace recorder with
   Chrome/Perfetto export, metrics registry, stall watchdog, rate-limited
   log channel (new — see docs/observability.md)
+- ``dmlc_tpu.resilience`` — unified retry/backoff policy at the I/O
+  seams, deterministic fault injection, elastic gang supervision
+  (reference: the tracker's recover/DMLC_NUM_ATTEMPT story — see
+  docs/resilience.md)
 
 The hot byte path (sharding, parsing) has two implementations with identical
 semantics: a pure-Python golden (always available, used for parity tests) and a

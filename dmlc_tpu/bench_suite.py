@@ -31,6 +31,12 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                training shape)
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
+
+``--chaos <plan>`` arms a dmlc_tpu.resilience fault plan
+(DMLC_TPU_FAULTS grammar) for the whole run: configs must DEGRADE
+(retries at the instrumented seams, lower gbps) rather than abort —
+the chaos smoke the resilience tests pin. Injected-fault and retry
+counts ride in each config's JSON under "chaos".
 """
 
 from __future__ import annotations
@@ -801,7 +807,20 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "the dmlc_tpu.obs trace recorder and export "
                          "Chrome/Perfetto trace-event JSON (one file "
                          "per config when several run)")
+    ap.add_argument("--chaos", default=None, metavar="PLAN",
+                    help="arm a dmlc_tpu.resilience fault plan "
+                         "(DMLC_TPU_FAULTS grammar) for the whole "
+                         "run; configs must degrade gracefully, not "
+                         "abort")
     args = ap.parse_args(argv)
+    chaos_plan = None
+    chaos_injected0 = 0
+    chaos_retries0: Dict[str, int] = {}
+    if args.chaos:
+        from dmlc_tpu.resilience import inject as _inject
+        chaos_plan = _inject.install(args.chaos)
+        _log(f"chaos: fault plan armed: {chaos_plan.spec()} "
+             f"(seed {chaos_plan.seed})")
     # live telemetry opt-ins (no-ops without their env vars): a set
     # DMLC_TPU_SERVE_PORT makes the running configs scrapeable
     # (/metrics, /healthz), DMLC_TPU_FLIGHT_DIR leaves a post-mortem
@@ -837,9 +856,29 @@ def main(argv: Optional[List[str]] = None) -> None:
             out["gbps"] = round(out["gbps"], 4)
             if trace_path:
                 out["trace"] = trace_path
+            if chaos_plan is not None:
+                # per-config DELTAS: cumulative totals would miscredit
+                # config 1's faults/retries to every later config
+                from dmlc_tpu.resilience import retry_counts
+                now = retry_counts()
+                out["chaos"] = {
+                    "plan": chaos_plan.spec(),
+                    "seed": chaos_plan.seed,
+                    "injected": chaos_plan.injected - chaos_injected0,
+                    "retries": {k: d for k, v in now.items()
+                                if (d := v - chaos_retries0.get(k, 0))},
+                }
             _emit(out)
         except Exception as e:  # noqa: BLE001
             _emit({"config": name, "error": str(e)[:200]})
+        finally:
+            if chaos_plan is not None:
+                # advance the delta baselines on BOTH outcomes: a
+                # failed config's faults must not be credited to the
+                # next config's accounting
+                from dmlc_tpu.resilience import retry_counts
+                chaos_injected0 = chaos_plan.injected
+                chaos_retries0 = retry_counts()
 
 
 if __name__ == "__main__":

@@ -118,9 +118,25 @@ class DiskRowIter(RowBlockIter):
         self.cache_file = cache_file
         self._max_index = 0
         if not os.path.exists(cache_file):
-            parser = (parser_factory() if callable(parser_factory)
-                      else parser_factory)
-            self._build_cache(parser, cache_file, rows_per_page)
+            if callable(parser_factory):
+                # the build is THE retry site of this iterator (a
+                # transient source error mid-parse used to abort the
+                # whole cache): each policy attempt re-creates the
+                # parser and rebuilds into a fresh pid-named tmp —
+                # migrated from hand-rolled handling onto
+                # resilience.RetryPolicy (site data.pages.build)
+                from dmlc_tpu.resilience.policy import guarded
+
+                def build_once() -> None:
+                    self._max_index = 0
+                    self._build_cache(parser_factory(), cache_file,
+                                      rows_per_page)
+
+                guarded("data.pages.build", build_once)
+            else:
+                # a pre-built parser cannot be re-created: one shot
+                self._build_cache(parser_factory, cache_file,
+                                  rows_per_page)
         else:
             # scan cached pages once for num_col
             with create_stream(cache_file, "r") as s:
@@ -155,26 +171,34 @@ class DiskRowIter(RowBlockIter):
                 except OSError:
                     pass
         tmp = f"{cache_file}.tmp.{os.getpid()}"
-        with create_stream(tmp, "w") as out:
-            pending = RowBlockContainer(parser.index_dtype)
-            parser.before_first()
-            while parser.next():
-                block = parser.value()
-                if len(block.index):
-                    self._max_index = max(self._max_index,
-                                          int(block.index.max()))
-                start = 0
-                while start < block.size:
-                    take = min(block.size - start, rows_per_page - pending.size)
-                    pending.push_block(block.slice(start, start + take))
-                    start += take
-                    if pending.size >= rows_per_page:
-                        pending.save(out)
-                        pending.clear()
-            if pending.size:
-                pending.save(out)
-        if hasattr(parser, "destroy"):
-            parser.destroy()
+        try:
+            with create_stream(tmp, "w") as out:
+                pending = RowBlockContainer(parser.index_dtype)
+                parser.before_first()
+                while parser.next():
+                    block = parser.value()
+                    if len(block.index):
+                        self._max_index = max(self._max_index,
+                                              int(block.index.max()))
+                    start = 0
+                    while start < block.size:
+                        take = min(block.size - start,
+                                   rows_per_page - pending.size)
+                        pending.push_block(block.slice(start,
+                                                       start + take))
+                        start += take
+                        if pending.size >= rows_per_page:
+                            pending.save(out)
+                            pending.clear()
+                if pending.size:
+                    pending.save(out)
+        finally:
+            # destroy in a finally: a mid-parse failure under the
+            # data.pages.build retry policy must not leak this
+            # attempt's native parser (arenas pinned for the process
+            # lifetime, one per failed attempt)
+            if hasattr(parser, "destroy"):
+                parser.destroy()
         os.replace(tmp, cache_file)
 
     def _open(self) -> None:
@@ -289,13 +313,18 @@ class RoundSpillWriter:
 
     def commit(self) -> "RoundSpillFile":
         from dmlc_tpu.obs import trace as _trace
+        from dmlc_tpu.resilience.policy import guarded
         with _trace.span("spill.commit", "io",
                          {"rounds": self.rounds, "path": self.path}):
             ser.write_u32(self._s, _SPILL_END_MAGIC)
             ser.write_u64(self._s, self.rounds)
             self._s.close()
             self._s = None
-            os.replace(self._tmp, self.path)
+            # resilience seam spill.commit: the atomic publish rename
+            # is idempotent, so transient errors (and injected chaos)
+            # retry under policy instead of abandoning the spill tier
+            guarded("spill.commit",
+                    lambda: os.replace(self._tmp, self.path))
         return RoundSpillFile(self.path, self.nparts, self.rounds)
 
     def abort(self) -> None:

@@ -33,6 +33,7 @@ import numpy as np
 
 from dmlc_tpu.io.stream import create_stream
 from dmlc_tpu.obs import trace as _trace
+from dmlc_tpu.resilience.policy import guarded
 from dmlc_tpu.utils import serializer as ser
 from dmlc_tpu.utils.json_util import json_dump, json_load
 from dmlc_tpu.utils.logging import DMLCError, check, check_eq
@@ -81,28 +82,45 @@ def _flatten(tree: Any) -> Tuple[List[Tuple[str, Any]], Any]:
 
 @_spanned("checkpoint.save_pytree")
 def save_pytree(tree: Any, uri: str) -> None:
-    """Serialize a pytree of arrays to one stream (single-host path)."""
+    """Serialize a pytree of arrays to one stream (single-host path).
+
+    The whole write is a resilience seam (site ``checkpoint.save``):
+    idempotent, so a transient I/O failure rewrites from scratch under
+    the site's RetryPolicy. (ShardedCheckpoint's multi-process save is
+    NOT op-level retried — its barriers forbid solo re-entry — but its
+    per-shard streams ride the io.stream.* seams.)"""
     leaves, _ = _flatten(tree)
-    with create_stream(uri, "w") as s:
-        ser.write_u32(s, _FORMAT_VERSION)
-        ser.write_u64(s, len(leaves))
-        for key, leaf in leaves:
-            ser.write_str(s, key)
-            ser.write_ndarray(s, np.asarray(leaf))
+
+    def write() -> None:
+        with create_stream(uri, "w") as s:
+            ser.write_u32(s, _FORMAT_VERSION)
+            ser.write_u64(s, len(leaves))
+            for key, leaf in leaves:
+                ser.write_str(s, key)
+                ser.write_ndarray(s, np.asarray(leaf))
+
+    guarded("checkpoint.save", write)
 
 
 @_spanned("checkpoint.load_pytree")
 def load_pytree(uri: str, like: Optional[Any] = None) -> Any:
     """Load a checkpoint; returns {key: array}, or the structure of
     ``like`` when given (keys must match)."""
-    with create_stream(uri, "r") as s:
-        version = ser.read_u32(s)
-        check_eq(version, _FORMAT_VERSION, "checkpoint version mismatch")
-        n = ser.read_u64(s)
-        flat: Dict[str, np.ndarray] = {}
-        for _ in range(n):
-            key = ser.read_str(s)
-            flat[key] = ser.read_ndarray(s)
+    def read() -> Dict[str, np.ndarray]:
+        with create_stream(uri, "r") as s:
+            version = ser.read_u32(s)
+            check_eq(version, _FORMAT_VERSION,
+                     "checkpoint version mismatch")
+            n = ser.read_u64(s)
+            out: Dict[str, np.ndarray] = {}
+            for _ in range(n):
+                key = ser.read_str(s)
+                out[key] = ser.read_ndarray(s)
+        return out
+
+    # resilience seam checkpoint.restore: a transient read failure
+    # re-reads the whole (immutable) file under the site's policy
+    flat = guarded("checkpoint.restore", read)
     if like is None:
         return flat
     import jax

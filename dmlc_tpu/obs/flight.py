@@ -35,6 +35,8 @@ Bundle layout (one timestamped dir per process under ``out_dir``)::
       env.json        # argv, python, platform, DMLC_*/JAX_* env
       error.txt       # the traceback (exception dumps)
       fatal.txt       # faulthandler output (fatal-signal deaths)
+      faults.json     # armed fault plan + injected-fault log (only
+                      # when dmlc_tpu.resilience.inject chaos was on)
 
 Wiring: ``install()`` / ``uninstall()`` directly, or
 :func:`install_if_env` under ``DMLC_TPU_FLIGHT_DIR`` (set per worker
@@ -283,6 +285,20 @@ class FlightRecorder:
                 "history": list(self._metrics_history),
                 "interval_s": self.metrics_interval_s,
             })
+            try:
+                from dmlc_tpu.resilience import inject as _inject
+                plan = _inject.active()
+            except Exception:  # noqa: BLE001 — optional section
+                plan = None
+            if plan is not None:
+                # the chaos that was armed when the process died: a
+                # post-mortem of an injected crash names its fault
+                _write_json("faults.json", {
+                    "plan": plan.spec(),
+                    "seed": plan.seed,
+                    "injected": plan.injected,
+                    "events": plan.events(),
+                })
             wd = _watchdog.active()
             _write_json("watchdog.json", {
                 "installed": wd is not None,

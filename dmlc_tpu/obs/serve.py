@@ -379,11 +379,21 @@ def shutdown() -> None:
 def scrape(port: int, host: str = "127.0.0.1",
            path: str = "/metrics.json",
            timeout_s: float = 5.0) -> Dict[str, Any]:
-    """GET one rank's JSON endpoint (stdlib urllib; no deps)."""
+    """GET one rank's JSON endpoint (stdlib urllib; no deps).
+
+    A resilience seam (site ``obs.scrape``, fail-fast 2-attempt site
+    default): one dropped connection does not mark a live rank
+    unreachable in the merged gang view."""
     from urllib.request import urlopen
-    with urlopen(f"http://{host}:{port}{path}",
-                 timeout=timeout_s) as resp:
-        return json.load(resp)
+
+    from dmlc_tpu.resilience.policy import guarded
+
+    def get() -> Dict[str, Any]:
+        with urlopen(f"http://{host}:{port}{path}",
+                     timeout=timeout_s) as resp:
+            return json.load(resp)
+
+    return guarded("obs.scrape", get)
 
 
 def scrape_gang(ports: Optional[List[int]] = None,

@@ -49,6 +49,10 @@ ENV_TRACE_DIR = "DMLC_TPU_TRACE_DIR"
 ENV_SERVE_PORT = "DMLC_TPU_SERVE_PORT"    # this worker's status port
 ENV_SERVE_PORTS = "DMLC_TPU_SERVE_PORTS"  # comma-joined gang ports
 ENV_FLIGHT_DIR = "DMLC_TPU_FLIGHT_DIR"    # crash-bundle output dir
+# resilience contracts (dmlc_tpu.resilience): launch_local(faults=...)
+# sets DMLC_TPU_FAULTS for every member; the gang supervisor sets
+# DMLC_TPU_ATTEMPT (alias DMLC_NUM_ATTEMPT — the reference's rejoin
+# counter) to 0 on first spawn and bumps it per restart
 
 # env contract (reference: slave_envs in tracker.py)
 ENV_COORD = "DMLC_TPU_COORDINATOR_URI"
@@ -194,7 +198,9 @@ def launch_local(num_workers: int, command: Sequence[str],
                  num_servers: int = 0,
                  trace_dir: Optional[str] = None,
                  serve_ports=None,
-                 flight_dir: Optional[str] = None) -> List[int]:
+                 flight_dir: Optional[str] = None,
+                 restart_policy=None,
+                 faults=None) -> List[int]:
     """Run N worker processes on this host (reference: local.py).
 
     With ``num_servers > 0`` (reference: dmlc-submit --num-servers +
@@ -203,6 +209,27 @@ def launch_local(num_workers: int, command: Sequence[str],
     (DMLC_PS_ROOT_URI/PORT, DMLC_ROLE) — the command branches on
     ``get_role()``. Workers carry BOTH contracts; the jax gang is
     workers-only.
+
+    The gang is owned by a :class:`dmlc_tpu.resilience.GangSupervisor`:
+    a worker that **exits 0 early** is a finished member (the gang
+    keeps running; PS service roles that outlive every worker are
+    terminated cleanly), a worker that **dies** (nonzero exit or
+    signal) kills the gang on first failure — unless
+    ``restart_policy`` (a :class:`dmlc_tpu.resilience.RestartPolicy`,
+    or an int = max restarts per worker) is given, in which case the
+    dead worker is respawned with its SAME coordinates and a bumped
+    ``DMLC_TPU_ATTEMPT`` (alias ``DMLC_NUM_ATTEMPT``) up to the
+    budget, exploiting the determinism contract (tests/test_elastic).
+    Budget exhausted = prompt gang teardown (plus a launcher-side
+    flight bundle when ``flight_dir`` is set), never a hang. Restarts
+    surface as ``dmlc_resilience_restart_total`` on the launcher's
+    /metrics and as ``gang/restart/<member>`` instants on the merged
+    gang trace.
+
+    ``faults`` (a spec string or :class:`dmlc_tpu.resilience.FaultPlan`)
+    hands every member the ``DMLC_TPU_FAULTS`` chaos contract — members
+    opt in with one ``resilience.inject.install_if_env()`` call, and
+    the seeded plan makes every run provoke identical failures.
 
     ``trace_dir`` hands every worker the obs tracing contract
     (``DMLC_TPU_TRACE_DIR``): workers that wrap their run in
@@ -264,83 +291,67 @@ def launch_local(num_workers: int, command: Sequence[str],
             coordinator = f"127.0.0.1:{find_free_port()}"
         if num_servers > 0:
             ps_root = ("127.0.0.1", find_free_port())
-    import time as _time
-    procs: List[subprocess.Popen] = []
+    from dmlc_tpu.resilience import inject as _inject
+    from dmlc_tpu.resilience.supervise import (
+        GangMember, GangSupervisor, RestartPolicy,
+    )
+    if isinstance(restart_policy, int):
+        restart_policy = RestartPolicy(max_restarts=restart_policy)
+    fault_spec = fault_seed = None
+    if faults is not None:
+        if isinstance(faults, str):
+            fault_spec = faults
+        else:
+            # a FaultPlan's spec() carries clauses only — the plan
+            # seed must ride DMLC_TPU_FAULT_SEED or every worker's
+            # p= clauses would re-parse onto seed 0, not the armed one
+            fault_spec = faults.spec()
+            fault_seed = str(faults.seed)
 
-    def _kill_gang() -> None:
-        for p in procs:  # kill the whole gang, leak nothing
-            if p.poll() is None:
-                p.kill()
-        for p in procs:
-            p.wait()
+    def _base_env() -> Dict[str, str]:
+        e = dict(os.environ)
+        if env:
+            e.update(env)
+        if fault_spec is not None:
+            e[_inject.ENV_FAULTS] = fault_spec
+        if fault_seed is not None:
+            e[_inject.ENV_FAULT_SEED] = fault_seed
+        return e
 
-    deadline = _time.monotonic() + timeout if timeout else None
-    try:
-        # spawning sits INSIDE the guard: a Popen failure mid-loop
-        # (EAGAIN/ENOMEM — likelier with PS roles multiplying the
-        # process count) must not leak the already-running half of the
-        # gang blocked in rendezvous on the coordinator port
-        for task_id in range(num_workers):
-            wenv = dict(os.environ)
-            if env:
-                wenv.update(env)
-            wenv.update(worker_envs(coordinator, num_workers, task_id))
-            if trace_dir is not None:
-                wenv[ENV_TRACE_DIR] = trace_dir
-            if serve_ports is not None:
-                wenv[ENV_SERVE_PORT] = str(serve_ports[task_id])
-                wenv[ENV_SERVE_PORTS] = ",".join(map(str, serve_ports))
-            if flight_dir is not None:
-                wenv[ENV_FLIGHT_DIR] = flight_dir
-            if ps_root is not None:
-                wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
-                                    num_servers, "worker", task_id))
-            procs.append(subprocess.Popen(list(command), env=wenv))
+    members: List[GangMember] = []
+    for task_id in range(num_workers):
+        wenv = _base_env()
+        wenv.update(worker_envs(coordinator, num_workers, task_id))
+        if trace_dir is not None:
+            wenv[ENV_TRACE_DIR] = trace_dir
+        if serve_ports is not None:
+            wenv[ENV_SERVE_PORT] = str(serve_ports[task_id])
+            wenv[ENV_SERVE_PORTS] = ",".join(map(str, serve_ports))
+        if flight_dir is not None:
+            wenv[ENV_FLIGHT_DIR] = flight_dir
         if ps_root is not None:
-            roles = [("scheduler", 0)] + [("server", i)
-                                          for i in range(num_servers)]
-            for role, task_id in roles:
-                renv = dict(os.environ)
-                if env:
-                    renv.update(env)
-                renv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
-                                    num_servers, role, task_id))
-                procs.append(subprocess.Popen(list(command), env=renv))
-        # Poll the whole gang instead of waiting sequentially (ADVICE
-        # r5): with num_servers > 0 and no timeout, a worker that dies
-        # at startup would leave scheduler/server processes (blocked
-        # waiting for the full DMLC world to register) running forever
-        # — launch_local would hang on them instead of reporting the
-        # worker failure. The moment ANY member exits nonzero, kill the
-        # remainder and raise with the codes collected so far.
-        codes = [None] * len(procs)
-        while any(c is None for c in codes):
-            if deadline is not None and _time.monotonic() > deadline:
-                raise subprocess.TimeoutExpired(list(command), timeout)
-            failed = False
-            for i, p in enumerate(procs):
-                if codes[i] is None:
-                    codes[i] = p.poll()
-                    if codes[i] is not None and codes[i] != 0:
-                        failed = True
-            if failed:
-                _kill_gang()
-                codes = [p.returncode if c is None else c
-                         for c, p in zip(codes, procs)]
-                raise DMLCError(
-                    f"worker failure, exit codes {codes} (gang killed "
-                    "on first nonzero exit)")
-            if any(c is None for c in codes):
-                _time.sleep(0.05)
-    except subprocess.TimeoutExpired:
-        _kill_gang()
-        raise DMLCError(
-            f"workers exceeded timeout {timeout}s; all killed") from None
-    except BaseException:
-        _kill_gang()
-        raise
-    if any(codes):
-        raise DMLCError(f"worker failure, exit codes {codes}")
+            wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
+                                num_servers, "worker", task_id))
+        members.append(GangMember(f"worker-{task_id}", "worker",
+                                  task_id, command, wenv))
+    if ps_root is not None:
+        roles = [("scheduler", 0)] + [("server", i)
+                                      for i in range(num_servers)]
+        for role, task_id in roles:
+            renv = _base_env()
+            renv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
+                                num_servers, role, task_id))
+            members.append(GangMember(f"{role}-{task_id}", role,
+                                      task_id, command, renv))
+    # The supervisor owns spawning (a Popen failure mid-loop must not
+    # leak the running half of the gang), the gang poll (exited-0-early
+    # members keep the gang running; a DIED member kills it on first
+    # failure or is restarted under restart_policy), the timeout, and
+    # PS-role drain once every worker finished (the pre-resilience loop
+    # hung on service roles that wait for work forever).
+    codes = GangSupervisor(members, restart_policy=restart_policy,
+                           timeout=timeout, trace_dir=trace_dir,
+                           flight_dir=flight_dir).run()
     if trace_dir is not None:
         merge_gang_traces(trace_dir)
     return codes

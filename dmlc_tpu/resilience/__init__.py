@@ -1,0 +1,51 @@
+"""dmlc_tpu.resilience — fault injection, retry policy, gang supervision.
+
+The production story the reference dmlc-core tells with its ``recover``
+handshake + ``DMLC_NUM_ATTEMPT`` rejoin (SURVEY §5.3), rebuilt as a
+first-class subsystem over this repo's determinism contract
+(docs/resilience.md):
+
+- :mod:`~dmlc_tpu.resilience.policy` — declarative
+  :class:`RetryPolicy` (attempts, exponential backoff + deterministic
+  jitter, per-attempt timeout, retryable classifier, shared
+  :class:`RetryBudget`), applied at named seams via :func:`guarded`;
+  configured in code or via ``DMLC_TPU_RETRY``;
+- :mod:`~dmlc_tpu.resilience.inject` — seeded, deterministic
+  :class:`FaultPlan` (site glob × {ioerror, truncate, delay, crash} ×
+  trigger) armed process-wide via ``DMLC_TPU_FAULTS``, firing inside
+  the SAME seams the retries guard;
+- :mod:`~dmlc_tpu.resilience.supervise` — :class:`GangSupervisor` +
+  :class:`RestartPolicy`: ``launch_local(restart_policy=...)``
+  restarts a dead worker with its same (part, num_parts, seed, epoch)
+  coordinates and a bumped ``DMLC_TPU_ATTEMPT``, up to a budget,
+  instead of killing the gang.
+
+Every retry, injected fault, and restart is observable through
+dmlc_tpu.obs: ``dmlc_resilience_retry_total`` /
+``dmlc_resilience_fault_injected_total`` /
+``dmlc_resilience_restart_total`` on /metrics, ``retry/<site>`` /
+``fault/<site>`` / ``gang/restart/<member>`` trace instants, and a
+``faults.json`` section in crash flight bundles.
+"""
+
+from dmlc_tpu.resilience import inject
+from dmlc_tpu.resilience.inject import (
+    CRASH_EXIT, ENV_FAULT_SEED, ENV_FAULTS, FaultClause, FaultPlan,
+)
+from dmlc_tpu.resilience.policy import (
+    ENV_RETRY, AttemptTimeout, RetryBudget, RetryPolicy, default_policy,
+    guarded, policy_for, reset_policies, retry_counts,
+    set_default_policy, set_policy,
+)
+from dmlc_tpu.resilience.supervise import (
+    ENV_ATTEMPT, GangMember, GangSupervisor, RestartPolicy,
+)
+
+__all__ = [
+    "RetryPolicy", "RetryBudget", "AttemptTimeout", "guarded",
+    "policy_for", "default_policy", "set_default_policy",
+    "set_policy", "reset_policies", "retry_counts", "ENV_RETRY",
+    "FaultPlan", "FaultClause", "inject", "ENV_FAULTS", "ENV_FAULT_SEED",
+    "CRASH_EXIT",
+    "RestartPolicy", "GangSupervisor", "GangMember", "ENV_ATTEMPT",
+]

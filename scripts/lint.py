@@ -20,6 +20,14 @@ without the tools baked in:
   the Prometheus exposition that ``obs/serve.py`` derives from the
   registry. And ``http.server`` may be used ONLY by ``obs/serve.py``:
   one status server per process, not one per module.
+- **Resilience gate** (always run, AST-based): inside ``dmlc_tpu``,
+  outside ``dmlc_tpu/resilience/``, hand-rolled retry loops (a loop
+  whose body both sleeps and swallows OSError-family exceptions) and
+  naked ``except OSError: continue`` handlers are forbidden — retries
+  are policy (``dmlc_tpu.resilience.RetryPolicy`` via ``guarded()``),
+  not ad-hoc control flow. The two pre-resilience skip-not-retry
+  handlers are pinned in an allowlist; the list shrinks, it does not
+  grow.
 - **ruff** over the Python tree and **clang-format --dry-run -Werror**
   over native/src/ — run when the binaries are importable/installed,
   reported as skipped otherwise.
@@ -214,6 +222,104 @@ def metric_lint(paths: List[str],
     return findings
 
 
+# the two pre-resilience "skip this file and move on" handlers (spill
+# sweeps): genuinely skip-not-retry, pinned. New code classifies and
+# retries through dmlc_tpu.resilience instead.
+OSERROR_CONTINUE_ALLOWED = {
+    "dmlc_tpu/data/row_iter.py",
+    "dmlc_tpu/parallel/sharded.py",
+}
+RETRY_LOOP_ALLOWED: set = set()
+_IO_EXC_NAMES = {"OSError", "IOError", "EnvironmentError",
+                 "ConnectionError", "TimeoutError"}
+
+
+def _handler_catches_io(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in _IO_EXC_NAMES for n in names)
+
+
+def _handler_swallows_io(handler: ast.ExceptHandler) -> bool:
+    """Catches an I/O exception AND does not re-raise: a handler that
+    converts to a typed error is classification, not a retry loop."""
+    if not _handler_catches_io(handler):
+        return False
+    return not any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _walk_same_scope(stmts) -> List[ast.AST]:
+    """Walk statements without descending into nested function/class
+    definitions — a sleep inside a worker closure defined in a loop is
+    not that loop retrying."""
+    out: List[ast.AST] = []
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _is_sleep_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "sleep"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("time", "_time")) or \
+           (isinstance(f, ast.Name) and f.id == "sleep")
+
+
+def resilience_lint(paths: List[str],
+                    trees: Optional[dict] = None) -> List[str]:
+    """The resilience gate: no hand-rolled sleep/retry loops and no
+    naked ``except OSError: continue`` in dmlc_tpu/ outside
+    dmlc_tpu/resilience/ (see module docstring)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel.startswith("dmlc_tpu/resilience/"):
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.ExceptHandler)
+                    and _handler_catches_io(node)
+                    and len(node.body) == 1
+                    and isinstance(node.body[0], ast.Continue)
+                    and rel not in OSERROR_CONTINUE_ALLOWED):
+                findings.append(
+                    f"{rel}:{node.lineno}: naked 'except OSError: "
+                    "continue' — classify and retry through "
+                    "dmlc_tpu.resilience (guarded()/RetryPolicy), or "
+                    "log the skip")
+            if (isinstance(node, (ast.While, ast.For))
+                    and rel not in RETRY_LOOP_ALLOWED):
+                body_nodes = _walk_same_scope(node.body)
+                sleeps = any(_is_sleep_call(n) for n in body_nodes)
+                catches = any(isinstance(n, ast.ExceptHandler)
+                              and _handler_swallows_io(n)
+                              for n in body_nodes)
+                if sleeps and catches:
+                    findings.append(
+                        f"{rel}:{node.lineno}: hand-rolled sleep/"
+                        "retry loop — use dmlc_tpu.resilience."
+                        "RetryPolicy (guarded(site, fn)) so attempts/"
+                        "backoff/classification are policy, not "
+                        "control flow")
+    return findings
+
+
 def run_ruff(root: str = REPO) -> Optional[List[str]]:
     """ruff findings, or None when ruff is not installed."""
     cmd = None
@@ -257,6 +363,7 @@ def main() -> int:
     trees = _parse_package_trees(paths)  # one parse, both AST gates
     findings += obs_lint(paths, trees)
     findings += metric_lint(paths, trees)
+    findings += resilience_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

@@ -93,3 +93,39 @@ class TestLintGate:
     def test_metric_gate_allows_serve_module(self):
         serve = os.path.join(lint.REPO, "dmlc_tpu", "obs", "serve.py")
         assert lint.metric_lint([serve]) == []
+
+    def test_resilience_gate_clean(self):
+        # no hand-rolled sleep/retry loops or naked except-OSError-
+        # continue outside dmlc_tpu/resilience/ and the pinned allowlist
+        findings = lint.resilience_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_resilience_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe.py")
+        with open(bad, "w") as f:
+            f.write("import time\n"
+                    "def pull(paths):\n"
+                    "    for p in paths:\n"
+                    "        try:\n"
+                    "            return open(p)\n"
+                    "        except OSError:\n"
+                    "            continue\n"
+                    "def fetch(fn):\n"
+                    "    while True:\n"
+                    "        try:\n"
+                    "            return fn()\n"
+                    "        except (IOError, ValueError):\n"
+                    "            time.sleep(0.1)\n")
+        try:
+            findings = lint.resilience_lint([bad])
+        finally:
+            os.remove(bad)
+        kinds = "\n".join(findings)
+        assert "naked 'except OSError: continue'" in kinds
+        assert "hand-rolled sleep/retry loop" in kinds
+
+    def test_resilience_gate_exempts_resilience_package(self):
+        # the policy engine itself sleeps between attempts, by design
+        pol = os.path.join(lint.REPO, "dmlc_tpu", "resilience",
+                           "policy.py")
+        assert lint.resilience_lint([pol]) == []
