@@ -14,14 +14,18 @@ tee assembles them, fingerprint-stamped in the header so staleness is
 self-describing (``sweep_stale_spill``), committed atomically via
 tmp + rename. ShardedRowBlockIter replays these rounds on steady epochs
 when the in-memory tier would exceed ``agreement_cache_bytes``.
+
+Since the objstore PR, BOTH tiers route their on-disk bytes through
+the unified :class:`dmlc_tpu.io.pagestore.PageStore`: one atomic
+tmp+rename commit protocol, one fingerprint-stamped sidecar, one byte
+budget with LRU eviction, and ONE stale sweep (``sweep_stale_spill``
+is now a thin delegate that adds the round-spill header-meta reader).
 """
 
 from __future__ import annotations
 
 import json
 import os
-import tempfile
-import time
 from typing import Any, Iterator, List, Optional
 
 import numpy as np
@@ -29,6 +33,7 @@ import numpy as np
 from dmlc_tpu.data.parser import DataIter, Parser
 from dmlc_tpu.data.rowblock import RowBlock, RowBlockContainer
 from dmlc_tpu.data.threaded_iter import ThreadedIter
+from dmlc_tpu.io.pagestore import PageStore, default_store_dir
 from dmlc_tpu.io.stream import create_stream
 from dmlc_tpu.io.uri_spec import URISpec
 from dmlc_tpu.utils import serializer as ser
@@ -69,7 +74,8 @@ class RowBlockIter(DataIter):
             # CachedInputSplit), and by role so a chunk cache using the
             # same hint stays distinct
             cache = f"{spec.cache_file}.pages.p{part_index}-{num_parts}"
-            return DiskRowIter(make_parser, cache)
+            return DiskRowIter(make_parser, cache,
+                               fingerprint=_source_fingerprint(parser_uri))
         return BasicRowIter(make_parser())
 
     def num_col(self) -> int:
@@ -109,15 +115,39 @@ class BasicRowIter(RowBlockIter):
         return int(self._max_index) + 1
 
 
+def _source_fingerprint(uri: str):
+    """Best-effort ``[[path, size, mtime_ns], ...]`` stamp of a
+    parser's backing files — None when the source cannot be stat'ed
+    (the cache then trusts its existence, the pre-pagestore
+    contract)."""
+    try:
+        from dmlc_tpu.io.input_split import list_split_files
+        from dmlc_tpu.io.pagestore import stat_fingerprint
+        return stat_fingerprint(p for p, _ in list_split_files(uri))
+    except Exception:  # noqa: BLE001 — non-stat-able source
+        return None
+
+
 class DiskRowIter(RowBlockIter):
     """Parse once → binary page cache → threaded page replay
-    (reference: DiskRowIter<I>, pages via RowBlockContainer::Save/Load)."""
+    (reference: DiskRowIter<I>, pages via RowBlockContainer::Save/Load).
+
+    The cache is a :class:`~dmlc_tpu.io.pagestore.PageStore` entry:
+    built into a pid-unique tmp and published atomically, stamped with
+    the source ``fingerprint`` when the caller provides one (a stamped
+    cache whose sources changed is rebuilt instead of replayed — and
+    reclaimed by the one stale sweep), accounted against the store's
+    byte budget, and pinned against LRU eviction while this iterator
+    lives."""
 
     def __init__(self, parser_factory, cache_file: str,
-                 rows_per_page: int = 64 << 10):
+                 rows_per_page: int = 64 << 10, fingerprint=None):
         self.cache_file = cache_file
+        self._store, self._entry = PageStore.for_path(cache_file)
         self._max_index = 0
-        if not os.path.exists(cache_file):
+        present = (self._store.lookup(self._entry, fingerprint=fingerprint)
+                   is not None)
+        if not present:
             if callable(parser_factory):
                 # the build is THE retry site of this iterator (a
                 # transient source error mid-parse used to abort the
@@ -129,13 +159,13 @@ class DiskRowIter(RowBlockIter):
 
                 def build_once() -> None:
                     self._max_index = 0
-                    self._build_cache(parser_factory(), cache_file,
+                    self._build_cache(parser_factory(), fingerprint,
                                       rows_per_page)
 
                 guarded("data.pages.build", build_once)
             else:
                 # a pre-built parser cannot be re-created: one shot
-                self._build_cache(parser_factory, cache_file,
+                self._build_cache(parser_factory, fingerprint,
                                   rows_per_page)
         else:
             # scan cached pages once for num_col
@@ -147,51 +177,44 @@ class DiskRowIter(RowBlockIter):
                     if len(blk.index):
                         self._max_index = max(self._max_index,
                                               int(blk.index.max()))
+        self._store.pin(self._entry)
         self._iter: Optional[ThreadedIter] = None
         self._stream = None
         self._value: Optional[RowBlock] = None
 
-    def _build_cache(self, parser: Parser, cache_file: str,
+    def _build_cache(self, parser: Parser, fingerprint,
                      rows_per_page: int) -> None:
-        # pid-unique tmp: two processes racing to build the same cache
-        # (the derived-path pipeline tier makes that reachable) must not
-        # interleave writes into one tmp — each builds its own, the
-        # replaces are atomic, last complete build wins. Dead writers'
-        # orphans are reaped HERE (the retry site) as well as by
-        # sweep_stale_spill, because explicit cache paths live outside
-        # the spill dir and would otherwise accumulate one dataset-
-        # sized orphan per crashed build.
-        import glob
-        import re
-        for orphan in glob.glob(glob.escape(cache_file) + ".tmp.*"):
-            m = re.search(r"\.tmp\.(\d+)$", orphan)
-            if m and _pid_dead(int(m.group(1))):
-                try:
-                    os.remove(orphan)
-                except OSError:
-                    pass
-        tmp = f"{cache_file}.tmp.{os.getpid()}"
+        # the PageStore writer owns the pid-unique tmp discipline: two
+        # processes racing to build the same cache (the derived-path
+        # pipeline tier makes that reachable) each build their own tmp,
+        # the replaces are atomic, last complete build wins, and dead
+        # writers' orphans are reaped at writer creation as well as by
+        # the store sweep.
+        w = self._store.writer(self._entry, fingerprint=fingerprint,
+                               commit_site="data.pages.commit")
+        ok = False
         try:
-            with create_stream(tmp, "w") as out:
-                pending = RowBlockContainer(parser.index_dtype)
-                parser.before_first()
-                while parser.next():
-                    block = parser.value()
-                    if len(block.index):
-                        self._max_index = max(self._max_index,
-                                              int(block.index.max()))
-                    start = 0
-                    while start < block.size:
-                        take = min(block.size - start,
-                                   rows_per_page - pending.size)
-                        pending.push_block(block.slice(start,
-                                                       start + take))
-                        start += take
-                        if pending.size >= rows_per_page:
-                            pending.save(out)
-                            pending.clear()
-                if pending.size:
-                    pending.save(out)
+            out = w.stream
+            pending = RowBlockContainer(parser.index_dtype)
+            parser.before_first()
+            while parser.next():
+                block = parser.value()
+                if len(block.index):
+                    self._max_index = max(self._max_index,
+                                          int(block.index.max()))
+                start = 0
+                while start < block.size:
+                    take = min(block.size - start,
+                               rows_per_page - pending.size)
+                    pending.push_block(block.slice(start,
+                                                   start + take))
+                    start += take
+                    if pending.size >= rows_per_page:
+                        pending.save(out)
+                        pending.clear()
+            if pending.size:
+                pending.save(out)
+            ok = True
         finally:
             # destroy in a finally: a mid-parse failure under the
             # data.pages.build retry policy must not leak this
@@ -199,11 +222,17 @@ class DiskRowIter(RowBlockIter):
             # lifetime, one per failed attempt)
             if hasattr(parser, "destroy"):
                 parser.destroy()
-        os.replace(tmp, cache_file)
+            if not ok:
+                w.abort()
+        w.commit()
 
     def _open(self) -> None:
         self._close()
-        self._stream = create_stream(self.cache_file, "r")
+        self._stream = self._store.open_read(self._entry)
+        if self._stream is None:
+            raise DMLCError(
+                f"DiskRowIter: page cache {self.cache_file} vanished "
+                "(evicted or swept underneath a live iterator?)")
 
         def _next_page():
             return RowBlockContainer.load_block(self._stream)
@@ -248,6 +277,7 @@ class DiskRowIter(RowBlockIter):
     def __del__(self):
         try:
             self._close()
+            self._store.unpin(self._entry)
         except Exception:
             pass
 
@@ -263,26 +293,25 @@ _SPILL_VERSION = 1
 
 def default_spill_dir() -> str:
     """Where fingerprint-keyed spill files live unless the caller names
-    a directory (ShardedRowBlockIter(spill_dir=...))."""
-    return os.path.join(tempfile.gettempdir(), "dmlc_tpu_spill")
-
-
-# spill dirs this process has written into: sweep_stale_spill(None)
-# covers them all, so custom spill_dir users get the same resume-
-# boundary hygiene as the default dir (in-process knowledge only —
-# another process's custom dir is swept by that process's own restores)
-_KNOWN_SPILL_DIRS = set()
+    a directory (ShardedRowBlockIter(spill_dir=...)) — the unified
+    page-store default root (one dir, one sweep, one byte budget)."""
+    return default_store_dir()
 
 
 class RoundSpillWriter:
-    """Append rounds of raw RowBlocks to a page file; commit atomically.
+    """Append rounds of raw RowBlocks to a page-store entry; commit
+    atomically.
 
     Layout: header (magic, version, nparts, JSON meta carrying the
     source fingerprint) → ``rounds`` × ``nparts`` RowBlock pages
     (RowBlockContainer.save_block — the DiskRowIter page format) →
-    footer (end magic, round count). Writes go to ``path + ".tmp"`` and
-    land via os.replace only on commit, so a crashed or aborted spill
-    never masquerades as a complete cache.
+    footer (end magic, round count). The on-disk discipline is the
+    unified :class:`~dmlc_tpu.io.pagestore.PageStore`'s: writes go to a
+    pid-unique tmp and land via an atomic replace only on commit (under
+    the ``spill.commit`` retry site), the fingerprint is stamped in the
+    sidecar as well as the header, and committed bytes count against
+    the store's byte budget — so a crashed or aborted spill never
+    masquerades as a complete cache.
     """
 
     def __init__(self, path: str, nparts: int,
@@ -291,16 +320,17 @@ class RoundSpillWriter:
         self.path = path
         self.nparts = nparts
         self.rounds = 0
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-            _KNOWN_SPILL_DIRS.add(d)
-        self._tmp = path + ".tmp"
-        self._s = create_stream(self._tmp, "w")
+        meta = dict(meta or {})
+        store, entry = PageStore.for_path(path)
+        self._w = store.writer(
+            entry, fingerprint=meta.get("fingerprint"),
+            meta={k: v for k, v in meta.items() if k != "fingerprint"},
+            commit_site="spill.commit")
+        self._s = self._w.stream
         ser.write_u32(self._s, _SPILL_MAGIC)
         ser.write_u8(self._s, _SPILL_VERSION)
         ser.write_u8(self._s, nparts)
-        ser.write_str(self._s, json.dumps(meta or {}))
+        ser.write_str(self._s, json.dumps(meta))
 
     def add_row(self, blocks: List[RowBlock]) -> None:
         """One round: exactly ``nparts`` blocks (empty pads included —
@@ -313,31 +343,21 @@ class RoundSpillWriter:
 
     def commit(self) -> "RoundSpillFile":
         from dmlc_tpu.obs import trace as _trace
-        from dmlc_tpu.resilience.policy import guarded
         with _trace.span("spill.commit", "io",
                          {"rounds": self.rounds, "path": self.path}):
             ser.write_u32(self._s, _SPILL_END_MAGIC)
             ser.write_u64(self._s, self.rounds)
-            self._s.close()
             self._s = None
-            # resilience seam spill.commit: the atomic publish rename
-            # is idempotent, so transient errors (and injected chaos)
-            # retry under policy instead of abandoning the spill tier
-            guarded("spill.commit",
-                    lambda: os.replace(self._tmp, self.path))
+            # the PageWriter publishes under the spill.commit retry
+            # site: the atomic rename is idempotent, so transient
+            # errors (and injected chaos) retry under policy instead
+            # of abandoning the spill tier
+            self._w.commit()
         return RoundSpillFile(self.path, self.nparts, self.rounds)
 
     def abort(self) -> None:
-        if self._s is not None:
-            try:
-                self._s.close()
-            except Exception:  # noqa: BLE001 — teardown must not raise
-                pass
-            self._s = None
-        try:
-            os.remove(self._tmp)
-        except OSError:
-            pass
+        self._s = None
+        self._w.abort()
 
 
 class RoundSpillFile:
@@ -368,10 +388,8 @@ class RoundSpillFile:
             s.close()
 
     def delete(self) -> None:
-        try:
-            os.remove(self.path)
-        except OSError:
-            pass
+        store, entry = PageStore.for_path(self.path)
+        store.delete(entry)  # entry + sidecar stamp
 
 
 def _read_spill_header(s) -> dict:
@@ -394,139 +412,26 @@ def read_spill_meta(path: str) -> Optional[dict]:
         return None
 
 
-def _pid_dead(pid: int) -> bool:
-    """Liveness probe for a writer pid recorded on THIS host (spill
-    dirs are host-local tmp, so the probe is meaningful). Pid reuse can
-    keep a dead file one sweep longer — bounded, accepted. The ONE
-    liveness rule for every spill/cache cleanup site."""
-    if pid == os.getpid():
-        return False
-    try:
-        os.kill(pid, 0)
-        return False
-    except ProcessLookupError:
-        return True
-    except OSError:
-        return False  # alive but not ours (EPERM) — keep
-
-
-def _spill_owner(name: str) -> Optional[int]:
-    """Writer pid embedded in a round-spill file name
-    (rounds-<key>-p<pid>-<seq>.pages[.tmp]), or None."""
-    import re
-    m = re.search(r"-p(\d+)-\d+\.pages(\.tmp)?$", name)
-    return int(m.group(1)) if m else None
-
-
-def _spill_owner_dead(name: str) -> Optional[bool]:
-    """Liveness of the writer pid a spill file's name embeds: True =
-    dead, False = alive (or us), None = no pid in the name. A dead
-    owner's file can never be adopted (names are per-instance) and
-    would otherwise outlive every sweep of a stable dataset."""
-    pid = _spill_owner(name)
-    return None if pid is None else _pid_dead(pid)
-
-
 def sweep_stale_spill(spill_dir: Optional[str] = None,
                       max_tmp_age_s: float = 600.0) -> int:
-    """Delete spill/cache page files whose recorded source fingerprint
-    no longer matches a stat of the backing files, round-spill files
-    whose writer process is dead (crashed before its close() could
-    delete them), plus orphaned .tmp files older than ``max_tmp_age_s``
-    (younger ones may belong to a live writer). Returns files removed.
+    """THE stale sweep, delegated to :meth:`PageStore.sweep`: entries
+    whose recorded source fingerprint no longer matches a stat of the
+    backing files (sidecar stamp, or the round-spill header via
+    ``read_spill_meta``), files whose writer process is dead (crashed
+    before its close() could delete them), and orphaned .tmp files
+    older than ``max_tmp_age_s`` (younger ones may belong to a live
+    writer). Returns entries removed.
 
     Called from ShardedCheckpoint.restore(): a restore marks a resume
     boundary, and any page cache written against since-mutated inputs
     must not survive into the resumed run — the mutation contract says
     replay re-earns from a clean re-parse after the source changes.
     Live-owner files with matching fingerprints are left alone. With
-    ``spill_dir=None`` the sweep covers the default dir plus every
-    custom dir this process has spilled into.
-    """
+    ``spill_dir=None`` the sweep covers the default store root plus
+    every page-store root this process has touched (custom spill dirs,
+    explicit cache paths, hydrated remote blocks — one sweep)."""
     if spill_dir is None:
-        dirs = {default_spill_dir()} | set(_KNOWN_SPILL_DIRS)
+        dirs = {default_store_dir()} | set(PageStore.known_roots())
         return sum(sweep_stale_spill(d, max_tmp_age_s) for d in dirs)
-    from dmlc_tpu.io.tpu_fs import local_path
-    d = spill_dir
-    if not os.path.isdir(d):
-        return 0
-    removed = 0
-    now = time.time()
-    import re
-    names = set(os.listdir(d))
-    for name in sorted(names):
-        path = os.path.join(d, name)
-        # build temporaries come in two shapes: the round-spill tee's
-        # '<...>.pages.tmp' (writer pid embedded earlier in the name)
-        # and DiskRowIter's pid-suffixed '<...>.pages.tmp.<pid>'
-        tmp_m = re.search(r"\.tmp(?:\.(\d+))?$", name)
-        if tmp_m:
-            # a live writer's tmp is NEVER deleted, however slow the
-            # epoch (a stalled consumer can hold one open for ages);
-            # dead-owner tmps go now, anonymous ones by age only
-            if tmp_m.group(1):
-                dead = _pid_dead(int(tmp_m.group(1)))
-            else:
-                dead = _spill_owner_dead(name)
-            try:
-                if dead or (dead is None and
-                            now - os.path.getmtime(path) > max_tmp_age_s):
-                    os.remove(path)
-                    removed += 1
-            except OSError:
-                pass
-            continue
-        if name.endswith(".pages.meta.json"):
-            # sidecar without its page file (failed/crashed build):
-            # nothing will ever pair with it — sweep it directly
-            if name[:-len(".meta.json")] not in names:
-                try:
-                    os.remove(path)
-                    removed += 1
-                except OSError:
-                    pass
-            continue
-        if not name.endswith(".pages"):
-            continue
-        if _spill_owner_dead(name):
-            try:
-                os.remove(path)
-                removed += 1
-            except OSError:
-                pass
-            continue
-        meta = read_spill_meta(path)
-        if meta is None:
-            # DiskRowIter-format page caches carry their meta in a
-            # sidecar (written by the pipeline cache stage)
-            try:
-                with open(path + ".meta.json") as f:
-                    meta = json.load(f)
-            except (OSError, ValueError):
-                continue  # unknowable: never delete what we can't read
-        fp = meta.get("fingerprint")
-        if not fp:
-            continue
-        stale = False
-        for entry in fp:
-            fpath, size, mtime_ns = entry[0], entry[1], entry[2]
-            try:
-                # fingerprints record scheme-bearing paths (tpu://...);
-                # stat their local backing, same as _fingerprint_now —
-                # os.stat on the raw URI would misjudge EVERY such
-                # cache stale and delete a live iterator's file
-                st = os.stat(local_path(fpath))
-            except OSError:
-                stale = True
-                break
-            if st.st_size != size or st.st_mtime_ns != mtime_ns:
-                stale = True
-                break
-        if stale:
-            for victim in (path, path + ".meta.json"):
-                try:
-                    os.remove(victim)
-                    removed += 1
-                except OSError:
-                    pass
-    return removed
+    return PageStore.at(spill_dir).sweep(max_tmp_age_s,
+                                         header_meta=read_spill_meta)

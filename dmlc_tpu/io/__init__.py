@@ -17,6 +17,8 @@ from dmlc_tpu.io.recordio import (
 from dmlc_tpu.io.tpu_fs import (  # registers the tpu:// scheme on import
     TPUFileSystem, TPUSeekStream, recordio_device_batches,
 )
+from dmlc_tpu.io.pagestore import PageStore
+from dmlc_tpu.io import objstore  # registers obj:// + s3:// on import
 
 __all__ = [
     "Stream", "SeekStream", "MemoryStream", "Serializable", "create_stream",
@@ -24,4 +26,5 @@ __all__ = [
     "LocalFileSystem", "TemporaryDirectory", "InputSplit",
     "RecordIOWriter", "RecordIOReader", "RecordIOChunkReader", "RECORDIO_MAGIC",
     "TPUFileSystem", "TPUSeekStream", "recordio_device_batches",
+    "PageStore", "objstore",
 ]

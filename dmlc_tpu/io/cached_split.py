@@ -6,16 +6,26 @@ to a local cache file; later passes replay the cache (pure local reads).
 
 Cache format: sequence of ``u64 length | chunk bytes``; the cache path is
 suffixed with ``.pK-N`` so different (part, num_parts) shards never mix.
-A ``.done`` marker commits the cache (a torn first pass is re-run).
+
+The on-disk discipline is the unified page store
+(:mod:`dmlc_tpu.io.pagestore`): the first pass writes through a
+:class:`~dmlc_tpu.io.pagestore.PageWriter` (pid-unique tmp, atomic
+commit) and the committed entry is STAMPED with the source fingerprint
+(``[[path, size, mtime_ns], ...]`` of the base split's files, stat'ed
+through the FileSystem seam so remote ``obj://`` sources stamp too).
+The pre-pagestore ``.done`` marker trusted the cache forever; now a
+lookup against the current fingerprint detects a changed source and
+RE-RUNS the first pass instead of replaying stale bytes, and the entry
+participates in the one store sweep and byte budget.
 """
 
 from __future__ import annotations
 
-import os
 import struct
 from typing import Iterator, Optional
 
 from dmlc_tpu.io.input_split import InputSplit
+from dmlc_tpu.io.pagestore import PageStore, stat_fingerprint
 from dmlc_tpu.utils.logging import check
 
 __all__ = ["CachedInputSplit"]
@@ -34,11 +44,20 @@ class CachedInputSplit(InputSplit):
         part = getattr(self._base, "part_index", 0)
         npart = getattr(self._base, "num_parts", 1)
         self._cache_path = f"{self._cache_template}.p{part}-{npart}"
-        self._done_path = self._cache_path + ".done"
+        self._store, self._entry = PageStore.for_path(self._cache_path)
 
-    @property
-    def _cached(self) -> bool:
-        return os.path.exists(self._done_path)
+    def _fingerprint(self):
+        """Current ``[[path, size, mtime_ns], ...]`` of the base
+        split's backing files, or None when they cannot be stat'ed
+        (the cache then trusts its existence — the fallback when a
+        base split does not expose its file list)."""
+        files = getattr(self._base, "_files", None)
+        if not files:
+            return None
+        try:
+            return stat_fingerprint(p for p, _ in files)
+        except Exception:  # noqa: BLE001 — non-stat-able source
+            return None
 
     def before_first(self) -> None:
         self._recbuf = None
@@ -46,20 +65,26 @@ class CachedInputSplit(InputSplit):
         self._bytes = 0
         if self._writer is not None:
             # torn pass: discard partial cache
-            self._writer.close()
+            self._writer.abort()
             self._writer = None
-            try:
-                os.remove(self._cache_path + ".tmp")
-            except OSError:
-                pass
         if self._reader is not None:
             self._reader.close()
             self._reader = None
-        if not self._cached:
+        # one lookup per pass: a committed entry whose stamp matches
+        # the CURRENT source fingerprint replays; a stale stamp deletes
+        # the entry (lookup counts the miss) and the first pass re-runs
+        fp = self._fingerprint()
+        cached = self._store.lookup(self._entry, fingerprint=fp)
+        if cached is None:
             self._base.before_first()
-            self._writer = open(self._cache_path + ".tmp", "wb")
+            self._writer = self._store.writer(self._entry,
+                                              fingerprint=fp)
         else:
-            self._reader = open(self._cache_path, "rb")
+            self._reader = self._store.open_read(self._entry)
+            if self._reader is None:  # evicted between lookup and open
+                self._base.before_first()
+                self._writer = self._store.writer(self._entry,
+                                                  fingerprint=fp)
 
     def next_chunk(self) -> Optional[bytes]:
         if self._reader is None and self._writer is None:
@@ -69,17 +94,16 @@ class CachedInputSplit(InputSplit):
             if len(head) < 8:
                 return None
             (n,) = struct.unpack("<Q", head)
-            chunk = self._reader.read(n)
+            chunk = self._reader.read_exact(n) if n else b""
             check(len(chunk) == n, "cache file truncated")
             self._bytes += n
             return chunk
         chunk = self._base.next_chunk()
         if chunk is None:
-            # commit the cache
-            self._writer.close()
+            # atomic commit + fingerprint stamp (replaces the old
+            # trust-forever .done marker)
+            self._writer.commit()
             self._writer = None
-            os.replace(self._cache_path + ".tmp", self._cache_path)
-            open(self._done_path, "wb").close()
             return None
         self._writer.write(struct.pack("<Q", len(chunk)))
         self._writer.write(chunk)
@@ -105,9 +129,11 @@ class CachedInputSplit(InputSplit):
 
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         self._base.reset_partition(part_index, num_parts)
+        if self._writer is not None:
+            self._writer.abort()
+            self._writer = None
         self._configure_paths()
         self._reader = None
-        self._writer = None
         self.before_first()
 
     def get_total_size(self) -> int:

@@ -63,10 +63,14 @@ class URI:
 
 @dataclass
 class FileInfo:
-    """Reference: FileInfo{path, size, type}."""
+    """Reference: FileInfo{path, size, type}. ``mtime_ns`` extends the
+    reference shape so fingerprint stamps (io/pagestore.py) can stat
+    any registered scheme through one seam; backends without a
+    modification clock report 0."""
     path: str
     size: int
     type: str  # "file" | "directory"
+    mtime_ns: int = 0
 
 
 class FileSystem:
@@ -132,7 +136,8 @@ class LocalFileSystem(FileSystem):
         # resilience seam io.filesys.stat (retry policy + fault plan)
         st = guarded("io.filesys.stat", lambda: os.stat(uri.name))
         ftype = "directory" if _stat.S_ISDIR(st.st_mode) else "file"
-        return FileInfo(path=uri.name, size=st.st_size, type=ftype)
+        return FileInfo(path=uri.name, size=st.st_size, type=ftype,
+                        mtime_ns=st.st_mtime_ns)
 
     def list_directory(self, uri: URI) -> List[FileInfo]:
         def scan() -> List[FileInfo]:
@@ -143,7 +148,7 @@ class LocalFileSystem(FileSystem):
                 ftype = ("directory" if _stat.S_ISDIR(st.st_mode)
                          else "file")
                 out.append(FileInfo(path=full, size=st.st_size,
-                                    type=ftype))
+                                    type=ftype, mtime_ns=st.st_mtime_ns))
             return out
         return guarded("io.filesys.list", scan)
 

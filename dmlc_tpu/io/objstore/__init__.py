@@ -1,0 +1,44 @@
+"""Remote object-store I/O plane: ``obj://`` URIs hydrating the
+unified page store.
+
+Reference: PAPER.md §1 "portable streams and virtual filesystems" —
+upstream dmlc-core ships S3/HDFS/Azure backends behind one
+``FileSystem`` interface. This package is that plane for the TPU
+framework: :class:`~dmlc_tpu.io.objstore.fs.ObjectStoreFileSystem`
+registered for ``obj://`` (with an ``s3://`` alias) in the existing
+scheme registry, reading through ranged parallel GETs with request
+coalescing and hydrating fetched blocks into
+:mod:`dmlc_tpu.io.pagestore` — so a second epoch over the same remote
+URI never touches the wire. The backend is a pluggable client
+protocol; this build ships the on-disk
+:class:`~dmlc_tpu.io.objstore.emulator.EmulatedObjectStore` (no
+network in this container — SURVEY §7), which is also the chaos/bench
+harness. See docs/remote_io.md.
+
+    from dmlc_tpu.io import objstore
+    em = objstore.configure(root="/tmp/objstore")   # emulator backend
+    em.put("bucket", "train/data.libsvm", payload)
+    Pipeline.from_uri("obj://bucket/train/data.libsvm").parse(
+        format="libsvm")...
+"""
+
+from dmlc_tpu.io.filesys import FileSystem
+from dmlc_tpu.io.objstore.emulator import EmulatedObjectStore, ObjectInfo
+from dmlc_tpu.io.objstore.fs import (
+    ENV_GBPS, ENV_LATENCY, ENV_ROOT, ObjectSeekStream,
+    ObjectStoreFileSystem, client, configure, options,
+)
+
+__all__ = [
+    "ObjectStoreFileSystem", "ObjectSeekStream", "EmulatedObjectStore",
+    "ObjectInfo", "configure", "client", "options",
+    "ENV_ROOT", "ENV_LATENCY", "ENV_GBPS",
+]
+
+# register the schemes: obj:// is the canonical name, s3:// aliases to
+# the same plane (replacing filesys.py's no-backend stub so S3-shaped
+# URIs reach the emulator/client instead of an immediate error)
+FileSystem.register_scheme("obj://",
+                           lambda: ObjectStoreFileSystem("obj://"))
+FileSystem.register_scheme("s3://",
+                           lambda: ObjectStoreFileSystem("s3://"))

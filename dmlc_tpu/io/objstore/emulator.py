@@ -1,0 +1,188 @@
+"""On-disk fake object store — the test/bench backend of the remote
+I/O plane.
+
+The container this repo grows in has no network and no cloud
+credentials (SURVEY §7), so the ``obj://`` plane is exercised against
+this emulator: a directory of ``<root>/<bucket>/<key>`` files behind
+the same client protocol a real S3/GCS backend would implement
+(``get``/``head``/``list``/``put``). Two things make it a *model*
+rather than a stub:
+
+- **latency/bandwidth shaping** — every GET pays ``latency_s`` plus
+  ``bytes / bandwidth`` of sleep, so cold-vs-warm epoch benchmarks
+  (bench_suite config 11) measure a believable wire, not a local read;
+- **first-class chaos** — the client seams (``io.objstore.get`` etc.,
+  see fs.py) run under ``resilience.guarded()``, so an armed
+  :class:`~dmlc_tpu.resilience.inject.FaultPlan` targets emulator
+  traffic exactly as it would real wire calls (ioerror, delay,
+  truncate, crash), with the emulator's request counters proving what
+  actually hit the "network".
+
+Counters (``gets``/``get_bytes``/``heads``/``lists``/``puts``) are the
+ground truth for the wire-free-second-epoch acceptance: a page-store
+hit rate can lie, a GET counter cannot.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from dmlc_tpu.utils.logging import DMLCError, check
+
+__all__ = ["ObjectInfo", "EmulatedObjectStore"]
+
+
+@dataclass
+class ObjectInfo:
+    """What a HEAD returns: enough for stat, listing, and the
+    fingerprint stamp (etag doubles as the change token)."""
+    key: str
+    size: int
+    mtime_ns: int
+
+    @property
+    def etag(self) -> str:
+        return f"{self.size}-{self.mtime_ns}"
+
+
+class EmulatedObjectStore:
+    """Bucket/key object store over a local directory.
+
+    Thread-safe; ranged GETs are byte-exact (``get(b, k, start, end)``
+    returns ``data[start:end]``). Keys may contain '/' — they map to
+    nested directories, and :meth:`list` is prefix-recursive the way
+    object-store listings are.
+    """
+
+    def __init__(self, root: str, latency_s: float = 0.0,
+                 bandwidth_gbps: Optional[float] = None):
+        self.root = os.path.abspath(root)
+        self.latency_s = float(latency_s)
+        self.bandwidth_gbps = bandwidth_gbps
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self.gets = 0
+        self.get_bytes = 0
+        self.heads = 0
+        self.lists = 0
+        self.puts = 0
+
+    # -- layout
+
+    def _path(self, bucket: str, key: str = "") -> str:
+        check(bucket and "/" not in bucket and ".." not in bucket,
+              f"objstore: invalid bucket {bucket!r}")
+        check(".." not in key.split("/"),
+              f"objstore: invalid key {key!r}")
+        p = os.path.join(self.root, bucket, *key.split("/")) if key \
+            else os.path.join(self.root, bucket)
+        return p
+
+    def _throttle(self, nbytes: int) -> None:
+        d = self.latency_s
+        if self.bandwidth_gbps:
+            d += nbytes / (self.bandwidth_gbps * 1e9)
+        if d > 0:
+            time.sleep(d)
+
+    # -- client protocol
+
+    def put(self, bucket: str, key: str, data: bytes) -> ObjectInfo:
+        p = self._path(bucket, key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+        with self._lock:
+            self.puts += 1
+        return self.head(bucket, key, count=False)
+
+    def put_file(self, bucket: str, key: str, src_path: str) -> ObjectInfo:
+        """Upload a local file (bench/test corpus loader)."""
+        with open(src_path, "rb") as f:
+            return self.put(bucket, key, f.read())
+
+    def head(self, bucket: str, key: str,
+             count: bool = True) -> ObjectInfo:
+        p = self._path(bucket, key)
+        if not os.path.isfile(p):
+            raise FileNotFoundError(
+                f"objstore: no object {bucket}/{key}")
+        st = os.stat(p)
+        if count:
+            with self._lock:
+                self.heads += 1
+        return ObjectInfo(key=key, size=st.st_size,
+                          mtime_ns=st.st_mtime_ns)
+
+    def is_prefix(self, bucket: str, key: str = "") -> bool:
+        """Whether any object lives under ``key`` as a prefix
+        (object-store "directory" semantics)."""
+        p = self._path(bucket, key)
+        return os.path.isdir(p)
+
+    def list(self, bucket: str, prefix: str = "") -> List[ObjectInfo]:
+        """All objects under ``prefix``, key-sorted (recursive, the
+        object-store listing shape)."""
+        base = self._path(bucket)
+        start = self._path(bucket, prefix) if prefix else base
+        with self._lock:
+            self.lists += 1
+        if not os.path.isdir(start):
+            if os.path.isfile(start):
+                return [self.head(bucket, prefix, count=False)]
+            return []
+        out: List[ObjectInfo] = []
+        for dirpath, dirnames, filenames in os.walk(start):
+            dirnames.sort()
+            for name in sorted(filenames):
+                full = os.path.join(dirpath, name)
+                key = os.path.relpath(full, base).replace(os.sep, "/")
+                st = os.stat(full)
+                out.append(ObjectInfo(key=key, size=st.st_size,
+                                      mtime_ns=st.st_mtime_ns))
+        out.sort(key=lambda o: o.key)
+        return out
+
+    def get(self, bucket: str, key: str, start: int = 0,
+            end: Optional[int] = None) -> bytes:
+        """Ranged GET: bytes ``[start, end)`` of the object (``end``
+        None = to the end). Pays the latency/bandwidth model."""
+        check(start >= 0, "objstore: negative range start")
+        p = self._path(bucket, key)
+        if not os.path.isfile(p):
+            raise FileNotFoundError(
+                f"objstore: no object {bucket}/{key}")
+        size = os.path.getsize(p)
+        stop = size if end is None else min(end, size)
+        if stop < start:
+            raise DMLCError(
+                f"objstore: bad range [{start}, {end}) for "
+                f"{bucket}/{key} (size {size})")
+        n = stop - start
+        with open(p, "rb") as f:
+            f.seek(start)
+            data = f.read(n)
+        self._throttle(len(data))
+        with self._lock:
+            self.gets += 1
+            self.get_bytes += len(data)
+        return data
+
+    # -- test/bench helpers
+
+    def reset_counters(self) -> None:
+        with self._lock:
+            self.gets = self.get_bytes = 0
+            self.heads = self.lists = self.puts = 0
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"gets": self.gets, "get_bytes": self.get_bytes,
+                    "heads": self.heads, "lists": self.lists,
+                    "puts": self.puts}

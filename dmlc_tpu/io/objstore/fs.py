@@ -1,0 +1,432 @@
+"""obj:// (and s3:// alias) FileSystem: ranged parallel GETs, request
+coalescing, and page-store hydration.
+
+Reference: src/io/s3_filesys.cc — upstream's S3 backend behind the one
+``FileSystem`` interface (CURL + HMAC there; a pluggable client
+protocol here, served by the on-disk emulator in this build — see
+emulator.py and SURVEY §7 for why no real wire exists in this
+container). The FileSystem surface is exactly the local one's, so
+``InputSplit``/parsers/``create_stream`` work over ``obj://`` URIs
+unmodified.
+
+Read path (:class:`ObjectSeekStream`):
+
+- the object is addressed in fixed ``block_bytes`` blocks;
+- a block miss first consults the unified page store
+  (:mod:`dmlc_tpu.io.pagestore`): hydrated blocks are ordinary local
+  pages, so a SECOND epoch over the same object performs ZERO wire
+  GETs (the acceptance the ``dmlc_objstore_*``/``dmlc_pagestore_*``
+  counters prove);
+- on a store miss the stream COALESCES the run of missing blocks ahead
+  (up to ``coalesce`` blocks) into one byte span and fetches it with up
+  to ``parallel`` concurrent ranged GETs — small adjacent reads become
+  few large requests, large spans keep the wire full;
+- every wire call runs under ``resilience.guarded()`` at the
+  ``io.objstore.get`` / ``io.objstore.stat`` / ``io.objstore.list`` /
+  ``io.objstore.put`` sites: transient errors retry under policy, an
+  armed FaultPlan injects there, and a truncated GET (chaos or a real
+  short object) is DETECTED against the requested range and retried —
+  never silently passed downstream;
+- wire traffic is counted (``objstore.get``, ``objstore.bytes``,
+  rendered ``dmlc_objstore_*_total``) and hydration hits/misses ride
+  the page-store counters.
+
+Hydrated entries are stamped with the object's ``[uri, size, mtime]``
+fingerprint AND keyed by its etag: a changed object changes the key
+(stale blocks are never served) and the stale sweep reclaims the old
+generation's pages.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import List, Optional, Tuple
+
+from dmlc_tpu.io.filesys import FileInfo, FileSystem, URI
+from dmlc_tpu.io.pagestore import PageStore
+from dmlc_tpu.io.stream import MemoryStream, SeekStream, Stream
+from dmlc_tpu.resilience import inject as _inject
+from dmlc_tpu.resilience.policy import guarded
+from dmlc_tpu.utils.logging import DMLCError, check
+
+__all__ = [
+    "ObjectStoreFileSystem", "ObjectSeekStream", "configure", "client",
+    "options", "ENV_ROOT", "ENV_LATENCY", "ENV_GBPS",
+]
+
+ENV_ROOT = "DMLC_TPU_OBJSTORE_ROOT"
+ENV_LATENCY = "DMLC_TPU_OBJSTORE_LATENCY_S"
+ENV_GBPS = "DMLC_TPU_OBJSTORE_GBPS"
+
+_lock = threading.Lock()
+_client = None
+_options = {
+    "block_bytes": 4 << 20,   # hydration/GET granularity
+    "coalesce": 4,            # max adjacent missing blocks per span
+    "parallel": 4,            # concurrent ranged GETs per span
+    "hydrate": True,          # write fetched blocks into the PageStore
+}
+
+
+_KEEP = object()  # configure() default: tune options, keep the client
+
+
+def configure(client_obj=_KEEP, *, root: Optional[str] = None,
+              latency_s: float = 0.0,
+              bandwidth_gbps: Optional[float] = None,
+              block_bytes: Optional[int] = None,
+              coalesce: Optional[int] = None,
+              parallel: Optional[int] = None,
+              hydrate: Optional[bool] = None):
+    """Install the process's object-store client (or build an
+    :class:`~dmlc_tpu.io.objstore.emulator.EmulatedObjectStore` over
+    ``root``) and tune the read path. Returns the installed client.
+    An explicit ``configure(None)`` with no root uninstalls; calling
+    with only option kwargs (e.g. ``configure(hydrate=False)``) tunes
+    the read path without touching the installed client."""
+    global _client
+    with _lock:
+        if client_obj is _KEEP and root is None:
+            client_obj = _client
+        elif client_obj is None or client_obj is _KEEP:
+            if root is not None:
+                from dmlc_tpu.io.objstore.emulator import (
+                    EmulatedObjectStore,
+                )
+                client_obj = EmulatedObjectStore(
+                    root, latency_s=latency_s,
+                    bandwidth_gbps=bandwidth_gbps)
+            else:
+                client_obj = None  # explicit uninstall
+        _client = client_obj
+        for key, val in (("block_bytes", block_bytes),
+                         ("coalesce", coalesce),
+                         ("parallel", parallel),
+                         ("hydrate", hydrate)):
+            if val is not None:
+                _options[key] = val
+        check(_options["block_bytes"] >= 1, "block_bytes must be >= 1")
+        check(_options["coalesce"] >= 1, "coalesce must be >= 1")
+        check(_options["parallel"] >= 1, "parallel must be >= 1")
+    return _client
+
+
+def client():
+    """The configured client; falls back to the ``DMLC_TPU_OBJSTORE_*``
+    env contract (an emulator over ``DMLC_TPU_OBJSTORE_ROOT``), so gang
+    workers inherit the launcher's store with zero code. None when
+    nothing is configured."""
+    global _client
+    with _lock:
+        if _client is not None:
+            return _client
+    root = os.environ.get(ENV_ROOT)
+    if root:
+        return configure(
+            root=root,
+            latency_s=float(os.environ.get(ENV_LATENCY, "0") or "0"),
+            bandwidth_gbps=(float(os.environ[ENV_GBPS])
+                            if os.environ.get(ENV_GBPS) else None))
+    return None
+
+
+def options() -> dict:
+    with _lock:
+        return dict(_options)
+
+
+def _count(which: str, n: int = 1) -> None:
+    try:
+        from dmlc_tpu.obs.metrics import REGISTRY
+        REGISTRY.counter(f"objstore.{which}").inc(n)
+    except Exception:  # noqa: BLE001 — telemetry must not break I/O
+        pass
+
+
+def _bucket_key(uri: URI) -> Tuple[str, str]:
+    return uri.host, uri.name.lstrip("/")
+
+
+class ObjectSeekStream(SeekStream):
+    """SeekStream over one remote object; see the module docstring for
+    the block/coalesce/parallel/hydrate read path."""
+
+    def __init__(self, client_obj, protocol: str, bucket: str, key: str,
+                 size: int, etag: str, mtime_ns: int,
+                 opts: Optional[dict] = None,
+                 store: Optional[PageStore] = None):
+        opts = opts or options()
+        self._c = client_obj
+        self._bucket = bucket
+        self._key = key
+        self.size = int(size)
+        self.path = f"{protocol}{bucket}/{key}"
+        self._bb = int(opts["block_bytes"])
+        self._coalesce = int(opts["coalesce"])
+        self._parallel = int(opts["parallel"])
+        self._store = (store if store is not None
+                       else (PageStore.default() if opts["hydrate"]
+                             else None))
+        # entry names carry the object identity AND its etag: a changed
+        # object hydrates a fresh generation, never mixes with the old
+        oh = hashlib.sha256(self.path.encode()).hexdigest()[:16]
+        eh = hashlib.sha256(str(etag).encode()).hexdigest()[:8]
+        self._entry_prefix = f"obj-{oh}-{eh}"
+        self._fingerprint = [[self.path, self.size, int(mtime_ns)]]
+        self._pos = 0
+        self._cur_ix = -1
+        self._cur = b""
+        self._pool = None
+
+    # -- SeekStream
+
+    def seek(self, pos: int) -> None:
+        check(0 <= pos <= self.size,
+              f"objstore seek {pos} out of range [0, {self.size}]")
+        self._pos = pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def read(self, nbytes: int) -> bytes:
+        if nbytes <= 0 or self._pos >= self.size:
+            return b""
+        ix = self._pos // self._bb
+        off = self._pos - ix * self._bb
+        buf = self._block(ix)
+        out = buf[off:off + nbytes]
+        self._pos += len(out)
+        return out
+
+    def write(self, data) -> int:
+        raise DMLCError("objstore: read-only stream (write via "
+                        "FileSystem.open(uri, 'w'))")
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    # -- block plane
+
+    def _nblocks(self) -> int:
+        return (self.size + self._bb - 1) // self._bb
+
+    def _entry(self, ix: int) -> str:
+        return f"{self._entry_prefix}.b{ix}.pages"
+
+    def _expected(self, ix: int) -> int:
+        return min(self.size, (ix + 1) * self._bb) - ix * self._bb
+
+    def _block(self, ix: int) -> bytes:
+        if ix == self._cur_ix:
+            return self._cur
+        data = None
+        if self._store is not None:
+            s = self._store.open_read(self._entry(ix))
+            if s is not None:
+                with s:
+                    data = s.read_all()
+                if len(data) != self._expected(ix):
+                    # torn/foreign page: refetch rather than serve it
+                    self._store.delete(self._entry(ix))
+                    data = None
+        if data is None:
+            data = self._fetch_span(ix)
+        self._cur_ix, self._cur = ix, data
+        return data
+
+    def _fetch_span(self, ix: int) -> bytes:
+        """Fetch the run of store-missing blocks starting at ``ix``
+        (request coalescing), as up to ``parallel`` concurrent ranged
+        GETs; hydrate every fetched block. Returns block ``ix``."""
+        last = min(ix + self._coalesce, self._nblocks())
+        j = ix + 1
+        while j < last and not (self._store is not None
+                                and self._store.exists(self._entry(j))):
+            j += 1
+        start, end = ix * self._bb, min(j * self._bb, self.size)
+        nblocks = j - ix
+        nway = min(self._parallel, nblocks)
+        # block-aligned contiguous sub-ranges, one ranged GET each
+        per = (nblocks + nway - 1) // nway
+        ranges = []
+        b = ix
+        while b < j:
+            hi = min(b + per, j)
+            ranges.append((b * self._bb, min(hi * self._bb, self.size)))
+            b = hi
+        if len(ranges) == 1:
+            datas = [self._get_range(*ranges[0])]
+        else:
+            datas = list(self._executor().map(
+                lambda r: self._get_range(*r), ranges))
+        span = b"".join(datas)
+        check(len(span) == end - start,
+              "objstore: span reassembly mismatch")
+        first = b""
+        for k in range(ix, j):
+            lo = k * self._bb - start
+            blk = span[lo:lo + self._expected(k)]
+            if k == ix:
+                first = blk
+            if self._store is not None:
+                self._hydrate(k, blk)
+        return first
+
+    def _executor(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._parallel,
+                thread_name_prefix="dmlc_tpu.objstore.get")
+        return self._pool
+
+    def _get_range(self, start: int, end: int) -> bytes:
+        """One ranged GET under the ``io.objstore.get`` seam. A short
+        payload — injected truncation or a really-shrunk object — is
+        detected against the requested range and raised as a transient
+        IOError, so the site's retry policy re-fetches instead of the
+        caller parsing shifted bytes."""
+        want = end - start
+
+        def attempt() -> bytes:
+            data = self._c.get(self._bucket, self._key, start, end)
+            data = _inject.corrupt("io.objstore.get", data)
+            if len(data) != want:
+                raise IOError(
+                    f"objstore: short ranged GET on {self.path} "
+                    f"[{start}, {end}): got {len(data)}/{want} bytes "
+                    "(truncated object or torn transfer)")
+            return data
+
+        data = guarded("io.objstore.get", attempt)
+        _count("get")
+        _count("bytes", len(data))
+        return data
+
+    def _hydrate(self, ix: int, data: bytes) -> None:
+        """Commit a fetched block into the page store (best-effort: a
+        full disk degrades to re-fetching, never kills the read)."""
+        name = self._entry(ix)
+        try:
+            w = self._store.writer(name, fingerprint=self._fingerprint,
+                                   meta={"block": ix})
+            try:
+                w.write(data)
+            except Exception:
+                w.abort()
+                raise
+            w.commit()
+        except Exception as e:  # noqa: BLE001 — cache trouble != I/O failure
+            try:
+                from dmlc_tpu.obs.log import warn_limited
+                warn_limited(
+                    "objstore-hydrate-failed",
+                    f"objstore: page hydration failed ({e}); reads "
+                    "will keep hitting the wire",
+                    min_interval_s=60.0, all_ranks=True)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class _ObjectWriteStream(Stream):
+    """Buffering write stream: bytes accumulate in RAM and PUT as one
+    object on close (object stores have no append)."""
+
+    def __init__(self, client_obj, bucket: str, key: str, path: str):
+        self._c = client_obj
+        self._bucket = bucket
+        self._key = key
+        self.path = path
+        self._buf: Optional[MemoryStream] = MemoryStream()
+
+    def write(self, data) -> int:
+        check(self._buf is not None, "objstore: write after close")
+        return self._buf.write(bytes(data))
+
+    def read(self, nbytes: int) -> bytes:
+        raise DMLCError("objstore: write-only stream")
+
+    def close(self) -> None:
+        if self._buf is None:
+            return
+        payload = self._buf.getvalue()
+        self._buf = None
+        guarded("io.objstore.put",
+                lambda: self._c.put(self._bucket, self._key, payload))
+        _count("put")
+
+
+class ObjectStoreFileSystem(FileSystem):
+    """The ``obj://`` scheme (``s3://`` aliases to it); resolves the
+    process's configured client lazily so registration at import time
+    costs nothing."""
+
+    def __init__(self, protocol: str = "obj://"):
+        self.protocol = protocol
+
+    def _client(self):
+        c = client()
+        if c is None:
+            raise DMLCError(
+                f"filesystem {self.protocol!r}: no object-store "
+                f"endpoint configured. Set {ENV_ROOT}=<dir> for the "
+                "on-disk emulator, or call "
+                "dmlc_tpu.io.objstore.configure(client_or_root) "
+                "(docs/remote_io.md).")
+        return c
+
+    def open(self, uri: URI, mode: str) -> Stream:
+        check(mode in ("r", "w"),
+              f"objstore: mode {mode!r} unsupported (no append on "
+              "object stores)")
+        if mode == "r":
+            return self.open_for_read(uri)
+        bucket, key = _bucket_key(uri)
+        check(bool(bucket) and bool(key),
+              f"objstore: need {self.protocol}bucket/key, got "
+              f"{uri.str_uri()!r}")
+        return _ObjectWriteStream(self._client(), bucket, key,
+                                  uri.str_uri())
+
+    def open_for_read(self, uri: URI) -> ObjectSeekStream:
+        c = self._client()
+        bucket, key = _bucket_key(uri)
+        info = guarded("io.objstore.stat",
+                       lambda: c.head(bucket, key))
+        _count("stat")
+        return ObjectSeekStream(c, self.protocol, bucket, key,
+                                size=info.size, etag=info.etag,
+                                mtime_ns=info.mtime_ns)
+
+    def get_path_info(self, uri: URI) -> FileInfo:
+        c = self._client()
+        bucket, key = _bucket_key(uri)
+        path = uri.str_uri()
+
+        def stat() -> FileInfo:
+            try:
+                info = c.head(bucket, key)
+                return FileInfo(path=path, size=info.size, type="file",
+                                mtime_ns=info.mtime_ns)
+            except FileNotFoundError:
+                if c.is_prefix(bucket, key):
+                    return FileInfo(path=path, size=0, type="directory")
+                raise
+
+        out = guarded("io.objstore.stat", stat)
+        _count("stat")
+        return out
+
+    def list_directory(self, uri: URI) -> List[FileInfo]:
+        c = self._client()
+        bucket, key = _bucket_key(uri)
+        infos = guarded("io.objstore.list",
+                        lambda: c.list(bucket, key))
+        _count("list")
+        return [FileInfo(path=f"{self.protocol}{bucket}/{o.key}",
+                         size=o.size, type="file", mtime_ns=o.mtime_ns)
+                for o in infos]

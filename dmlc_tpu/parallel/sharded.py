@@ -507,15 +507,13 @@ class ShardedRowBlockIter:
         within the SAME nanosecond tick as the fingerprinted stat —
         accepted (the re-parse path it replaced could also miss a
         same-size same-row-count rewrite)."""
-        import os
         from dmlc_tpu.io.input_split import list_split_files
-        from dmlc_tpu.io.tpu_fs import local_path
+        from dmlc_tpu.io.pagestore import stat_uri
         try:
             out = []
             for path, _size in list_split_files(self._uri):
-                st = os.stat(local_path(path))
-                out.append((path, st.st_size, st.st_mtime_ns,
-                            st.st_ctime_ns, st.st_ino))
+                size, mtime_ns, ctime_ns, ino = stat_uri(path)
+                out.append((path, size, mtime_ns, ctime_ns, ino))
             return tuple(out)
         except Exception:  # noqa: BLE001 — any non-stat-able backing
             return None
@@ -844,12 +842,11 @@ class ShardedRowBlockIter:
         same-size rewrite still go to the read-path detectors)."""
         if self._ctor_sizes is None:
             return
-        import os
-        from dmlc_tpu.io.tpu_fs import local_path
+        from dmlc_tpu.io.pagestore import stat_uri
         for path, size in self._ctor_sizes:
             try:
-                now = os.stat(local_path(path)).st_size
-            except OSError:
+                now = stat_uri(path)[0]
+            except (OSError, DMLCError):
                 continue  # deleted/unstatable: the read path reports it
             if now < size:
                 raise DMLCError(

@@ -288,22 +288,24 @@ class _CacheRunner(_RunnerBase):
         self._blocks: Optional[List] = None
         self._it = None
         self.tier = "pages"
-        sidecar = None
+        fingerprint = self._source_fingerprint(sp)
         if path is None:
             if self._try_memory(make_parser, budget):
                 self.tier = "memory"
                 return
-            path, sidecar = self._derived_page_path(
-                sp, fmt, cache.params["rows_per_page"])
+            path = self._derived_page_path(
+                sp, fmt, cache.params["rows_per_page"], fingerprint)
+        page_budget = cache.params.get("page_budget_bytes")
+        if page_budget is not None:
+            # the store owning this cache's root gets the byte budget
+            # (LRU eviction of cold committed entries down to it)
+            from dmlc_tpu.io.pagestore import PageStore
+            PageStore.for_path(path)[0].set_budget(page_budget)
+        # DiskRowIter stamps the sidecar itself at commit (and a
+        # stamped cache whose sources changed is rebuilt, not replayed)
         self._it = DiskRowIter(make_parser, path,
-                               rows_per_page=cache.params["rows_per_page"])
-        if sidecar is not None:
-            # sidecar AFTER the successful build: a failed build must
-            # not leave a meta file that nothing will ever pair with
-            # (sweep_stale_spill removes orphaned sidecars regardless)
-            import json as _json
-            with open(path + ".meta.json", "w") as f:
-                _json.dump(sidecar, f)
+                               rows_per_page=cache.params["rows_per_page"],
+                               fingerprint=fingerprint)
 
     def _try_memory(self, make_parser, budget: int) -> bool:
         """Drain the parser into owned raw blocks within the budget;
@@ -347,35 +349,34 @@ class _CacheRunner(_RunnerBase):
             return True
 
     @staticmethod
-    def _derived_page_path(sp, fmt, rows_per_page: int):
-        """(page path, sidecar meta or None) — fingerprint-keyed so a
-        changed source derives a fresh cache file; the CALLER writes
-        the sidecar once the cache build succeeds."""
-        import hashlib
-
-        from dmlc_tpu.data.row_iter import default_spill_dir
-        fingerprint = None
+    def _source_fingerprint(sp):
+        """``[[path, size, mtime_ns], ...]`` of the source's backing
+        files, stat'ed through the FileSystem seam (remote ``obj://``
+        sources stamp too), or None when non-stat-able."""
         try:
-            import os as _os
-
             from dmlc_tpu.io.input_split import list_split_files
-            from dmlc_tpu.io.tpu_fs import local_path
-            fingerprint = []
-            for fpath, _size in list_split_files(sp["uri"]):
-                st = _os.stat(local_path(fpath))
-                fingerprint.append([fpath, st.st_size, st.st_mtime_ns])
+            from dmlc_tpu.io.pagestore import stat_fingerprint
+            return stat_fingerprint(
+                p for p, _ in list_split_files(sp["uri"]))
         except Exception:  # noqa: BLE001 — non-stat-able source
-            fingerprint = None
+            return None
+
+    @staticmethod
+    def _derived_page_path(sp, fmt, rows_per_page: int, fingerprint):
+        """Page path under the default store root — fingerprint-keyed
+        so a changed source derives a fresh cache file (the stamp
+        DiskRowIter writes catches in-place mutation of an unchanged
+        name too)."""
+        import hashlib
+        import os as _os
+
+        from dmlc_tpu.io.pagestore import default_store_dir
         key = hashlib.sha256(repr(
             (sp["uri"], sp["part_index"], sp["num_parts"], fmt,
              rows_per_page, fingerprint)).encode()).hexdigest()[:16]
-        import os as _os
-        d = default_spill_dir()
+        d = default_store_dir()
         _os.makedirs(d, exist_ok=True)
-        path = _os.path.join(d, f"cache-{key}.pages")
-        sidecar = ({"fingerprint": fingerprint}
-                   if fingerprint is not None else None)
-        return path, sidecar
+        return _os.path.join(d, f"cache-{key}.pages")
 
     @property
     def queue(self):
@@ -850,13 +851,19 @@ class Pipeline:
 
     def cache(self, path: Optional[str] = None,
               rows_per_page: int = 64 << 10,
-              memory_budget_bytes: Optional[int] = None) -> "Pipeline":
+              memory_budget_bytes: Optional[int] = None,
+              page_budget_bytes: Optional[int] = None) -> "Pipeline":
         """Parse once; later epochs replay instead of re-parsing text.
         The tier is picked by budget (default 1 GiB; an explicit 0
         forces pages): raw blocks within ``memory_budget_bytes`` are
         retained in RAM, larger datasets spill to binary row pages
-        (DiskRowIter) under the spill dir, fingerprint-keyed. An
-        explicit ``path`` forces the page tier at that location.
+        (DiskRowIter) under the unified page store, fingerprint-keyed
+        AND fingerprint-stamped (a changed source rebuilds instead of
+        replaying). An explicit ``path`` forces the page tier at that
+        location. ``page_budget_bytes`` sets the owning page store's
+        byte budget: committed entries LRU-evict down to it (pinned
+        live caches are skipped) — the on-disk analogue of
+        ``memory_budget_bytes``.
 
         The memory tier serves the SAME RowBlock objects every epoch —
         RowBlock is immutable by contract, so downstream ``map`` fns
@@ -864,7 +871,8 @@ class Pipeline:
         a violation corrupts all later epochs instead of one)."""
         return self._with(StageSpec("cache", path=path,
                                     rows_per_page=rows_per_page,
-                                    memory_budget_bytes=memory_budget_bytes))
+                                    memory_budget_bytes=memory_budget_bytes,
+                                    page_budget_bytes=page_budget_bytes))
 
     def batch(self, rows: int, drop_remainder: bool = False) -> "Pipeline":
         """Re-chunk the block stream to exactly ``rows`` rows per block

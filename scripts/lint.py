@@ -222,6 +222,60 @@ def metric_lint(paths: List[str],
     return findings
 
 
+# Byte access goes through the FileSystem/stream seams in dmlc_tpu/io/
+# — that is where retry policies and fault plans apply (guarded() at
+# io.stream.*, io.filesys.*, io.objstore.*) and where the unified page
+# store stamps/accounts bytes. A direct open()/os.stat on a data path
+# elsewhere in the package silently bypasses all of it. The pinned
+# exceptions are files whose bytes are NOT data-path bytes: telemetry
+# output (trace exports, flight bundles, stall reports), bench corpus
+# builders and result JSON, launcher log capture, and the config file.
+# The list shrinks, it does not grow.
+IO_SEAM_ALLOWED = {
+    "dmlc_tpu/bench_mp_worker.py",   # gang-worker result JSON
+    "dmlc_tpu/bench_suite.py",       # corpus builders / BENCH JSON
+    "dmlc_tpu/obs/export.py",        # trace JSON export
+    "dmlc_tpu/obs/flight.py",        # crash flight bundles
+    "dmlc_tpu/obs/watchdog.py",      # stall reports
+    "dmlc_tpu/parallel/launch.py",   # per-rank log capture
+    "dmlc_tpu/utils/config.py",      # config file loader
+}
+
+
+def io_seam_lint(paths: List[str],
+                 trees: Optional[dict] = None) -> List[str]:
+    """The io-seam gate: no direct ``open()`` / ``os.stat()`` calls in
+    dmlc_tpu/ outside dmlc_tpu/io/ (see IO_SEAM_ALLOWED)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel.startswith("dmlc_tpu/io/") or rel in IO_SEAM_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "open":
+                findings.append(
+                    f"{rel}:{node.lineno}: direct open() outside "
+                    "dmlc_tpu/io/ — byte access goes through "
+                    "create_stream/FileSystem (or PageStore) so retry "
+                    "policies and fault plans apply")
+            elif (isinstance(f, ast.Attribute) and f.attr == "stat"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("os", "_os")):
+                findings.append(
+                    f"{rel}:{node.lineno}: direct os.stat() outside "
+                    "dmlc_tpu/io/ — stat through "
+                    "io.pagestore.stat_uri / FileSystem.get_path_info "
+                    "so remote schemes and fault plans apply")
+    return findings
+
+
 # the two pre-resilience "skip this file and move on" handlers (spill
 # sweeps): genuinely skip-not-retry, pinned. New code classifies and
 # retries through dmlc_tpu.resilience instead.
@@ -364,6 +418,7 @@ def main() -> int:
     findings += obs_lint(paths, trees)
     findings += metric_lint(paths, trees)
     findings += resilience_lint(paths, trees)
+    findings += io_seam_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

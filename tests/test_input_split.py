@@ -233,7 +233,10 @@ class TestCachedSplit:
         split = InputSplit.create(uri, 0, 1)
         first = list(split)
         assert first == lines
-        assert os.path.exists(str(cache) + ".p0-1.done")
+        # committed through the page store: entry + fingerprint stamp
+        # (the pre-pagestore .done marker is gone)
+        assert os.path.exists(str(cache) + ".p0-1")
+        assert os.path.exists(str(cache) + ".p0-1.meta.json")
         second = list(split)
         assert second == lines
         # replay must also work from a fresh object (cache hit)

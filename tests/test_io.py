@@ -37,9 +37,20 @@ class TestURI:
         assert u.str_uri() == "s3://bucket/key/a.txt"
 
     def test_unknown_scheme_stub_raises_on_use(self):
-        u = URI("s3://bucket/key")
+        # s3:// now routes to the objstore plane; hdfs:// remains a
+        # stub seam (no libhdfs in this build)
+        u = URI("hdfs://nn/key")
         fs = FileSystem.get_instance(u)
         with pytest.raises(DMLCError, match="no backend"):
+            fs.open_for_read(u)
+
+    def test_s3_aliases_objstore_plane(self, monkeypatch):
+        import dmlc_tpu.io.objstore as objstore
+        monkeypatch.delenv(objstore.ENV_ROOT, raising=False)
+        u = URI("s3://bucket/key")
+        fs = FileSystem.get_instance(u)
+        assert isinstance(fs, objstore.ObjectStoreFileSystem)
+        with pytest.raises(DMLCError, match="no object-store endpoint"):
             fs.open_for_read(u)
 
     def test_unregistered_scheme(self):

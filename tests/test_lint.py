@@ -129,3 +129,32 @@ class TestLintGate:
         pol = os.path.join(lint.REPO, "dmlc_tpu", "resilience",
                            "policy.py")
         assert lint.resilience_lint([pol]) == []
+
+    def test_io_seam_gate_clean(self):
+        # no direct open()/os.stat on data paths in dmlc_tpu/ outside
+        # dmlc_tpu/io/ and the pinned allowlist — byte access goes
+        # through the FileSystem/stream seams so retry policies and
+        # fault plans always apply
+        findings = lint.io_seam_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_io_seam_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe2.py")
+        with open(bad, "w") as f:
+            f.write("import os\n"
+                    "def load(p):\n"
+                    "    with open(p, 'rb') as fh:\n"
+                    "        return fh.read(), os.stat(p).st_size\n")
+        try:
+            findings = lint.io_seam_lint([bad])
+        finally:
+            os.remove(bad)
+        kinds = "\n".join(findings)
+        assert "direct open() outside dmlc_tpu/io/" in kinds
+        assert "direct os.stat() outside dmlc_tpu/io/" in kinds
+
+    def test_io_seam_gate_exempts_io_package_and_allowlist(self):
+        fsys = os.path.join(lint.REPO, "dmlc_tpu", "io", "filesys.py")
+        assert lint.io_seam_lint([fsys]) == []
+        flight = os.path.join(lint.REPO, "dmlc_tpu", "obs", "flight.py")
+        assert lint.io_seam_lint([flight]) == []

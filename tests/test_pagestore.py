@@ -1,0 +1,397 @@
+"""The unified page store (io/pagestore.py): commit/abort discipline,
+fingerprint stamps, byte-budget LRU eviction, the one sweep — plus the
+scheme-aware URISpec and the FileSystem scheme registry it builds on."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io.filesys import FileSystem, URI, LocalFileSystem
+from dmlc_tpu.io.pagestore import (
+    PageStore, fingerprint_fresh, stat_fingerprint, stat_uri,
+)
+from dmlc_tpu.io.uri_spec import URISpec
+from dmlc_tpu.utils.logging import DMLCError
+
+
+def _counter(name):
+    from dmlc_tpu.obs.metrics import REGISTRY
+    return REGISTRY.counter(name).value
+
+
+# ------------------------------------------------------ URISpec schemes
+
+class TestURISpecScheme:
+    def test_remote_uri_round_trips_with_protocol(self):
+        raw = "obj://bucket/key?format=csv&label_column=0#cachefile"
+        s = URISpec(raw)
+        assert s.uri == "obj://bucket/key"
+        assert s.scheme == "obj://"
+        assert s.args == {"format": "csv", "label_column": "0"}
+        assert s.cache_file == "cachefile"
+        assert s.str_spec() == raw
+
+    def test_bare_path_is_file_scheme(self):
+        s = URISpec("data/train.csv?format=csv")
+        assert s.scheme == "file://"
+        assert s.uri == "data/train.csv"
+        assert s.str_spec() == "data/train.csv?format=csv"
+
+    def test_tpu_scheme_round_trip(self):
+        s = URISpec("tpu:///tmp/x.rec#cache")
+        assert s.scheme == "tpu://"
+        assert s.uri == "tpu:///tmp/x.rec"
+        assert s.str_spec() == "tpu:///tmp/x.rec#cache"
+
+    def test_multipath_keeps_per_path_schemes(self):
+        s = URISpec("obj://b/a.txt;/local/b.txt;s3://c/d.txt")
+        assert s.paths() == ["obj://b/a.txt", "/local/b.txt",
+                             "s3://c/d.txt"]
+        assert s.scheme == "obj://"  # first path's protocol
+
+    def test_query_only_on_remote(self):
+        s = URISpec("s3://bucket/data.libsvm?format=libsvm")
+        assert s.uri == "s3://bucket/data.libsvm"
+        assert s.args == {"format": "libsvm"}
+        assert s.cache_file == ""
+
+    def test_fragment_only_on_remote(self):
+        s = URISpec("obj://bucket/data.txt#c.bin")
+        assert s.uri == "obj://bucket/data.txt"
+        assert s.cache_file == "c.bin"
+
+
+# ------------------------------------------------- FileSystem registry
+
+class TestFileSystemRegistry:
+    def test_unknown_scheme_error_names_registered(self):
+        with pytest.raises(DMLCError) as ei:
+            FileSystem.get_instance(URI("nope://x/y"))
+        msg = str(ei.value)
+        assert "nope://" in msg and "file://" in msg and "obj://" in msg
+
+    def test_allow_null_returns_none(self):
+        assert FileSystem.get_instance(URI("nope://x/y"),
+                                       allow_null=True) is None
+
+    def test_singleton_instance_caching(self):
+        a = FileSystem.get_instance(URI("/tmp/a"))
+        b = FileSystem.get_instance(URI("/tmp/b"))
+        assert a is b
+        assert isinstance(a, LocalFileSystem)
+
+    def test_reregistration_invalidates_cached_instance(self):
+        calls = []
+
+        class _FS(LocalFileSystem):
+            def __init__(self, tag):
+                calls.append(tag)
+                self.tag = tag
+
+        FileSystem.register_scheme("tstreg://", lambda: _FS("one"))
+        first = FileSystem.get_instance(URI("tstreg://h/p"))
+        assert first.tag == "one"
+        assert FileSystem.get_instance(URI("tstreg://h/p")) is first
+        FileSystem.register_scheme("tstreg://", lambda: _FS("two"))
+        second = FileSystem.get_instance(URI("tstreg://h/p"))
+        assert second is not first and second.tag == "two"
+        assert calls == ["one", "two"]  # factory once per registration
+
+    def test_register_requires_protocol_suffix(self):
+        with pytest.raises(DMLCError, match="://"):
+            FileSystem.register_scheme("bad", LocalFileSystem)
+
+
+# ------------------------------------------------------ stat plumbing
+
+class TestStatFingerprint:
+    def test_stat_uri_local(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"abc")
+        size, mtime_ns, ctime_ns, ino = stat_uri(str(p))
+        st = os.stat(p)
+        assert (size, mtime_ns) == (3, st.st_mtime_ns)
+        assert ino == st.st_ino
+
+    def test_fingerprint_fresh_and_stale(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"abc")
+        fp = stat_fingerprint([str(p)])
+        assert fingerprint_fresh(fp) is True
+        p.write_bytes(b"abcd")  # size change
+        assert fingerprint_fresh(fp) is False
+        assert fingerprint_fresh(None) is None
+        assert fingerprint_fresh(
+            [[str(tmp_path / "gone"), 1, 2]]) is False
+
+    def test_filesystem_stat_carries_mtime(self, tmp_path):
+        p = tmp_path / "g.bin"
+        p.write_bytes(b"xy")
+        u = URI(str(p))
+        info = FileSystem.get_instance(u).get_path_info(u)
+        assert info.mtime_ns == os.stat(p).st_mtime_ns
+
+
+# --------------------------------------------------------- the store
+
+class TestPageStore:
+    def _store(self, tmp_path, budget=None):
+        return PageStore.at(str(tmp_path / "store"), byte_budget=budget)
+
+    def test_commit_publishes_entry_and_stamp(self, tmp_path):
+        st = self._store(tmp_path)
+        fp = [["src", 10, 20]]
+        w = st.writer("e1.pages", fingerprint=fp, meta={"k": "v"})
+        w.write(b"payload")
+        path = w.commit()
+        assert os.path.exists(path)
+        stamp = st.stamp("e1.pages")
+        assert stamp["fingerprint"] == fp
+        assert stamp["k"] == "v"
+        assert stamp["bytes"] == len(b"payload")
+        # no tmp left behind
+        assert [n for n in os.listdir(st.root) if ".tmp" in n] == []
+
+    def test_abort_leaves_nothing(self, tmp_path):
+        st = self._store(tmp_path)
+        w = st.writer("e2.pages")
+        w.write(b"half")
+        w.abort()
+        assert os.listdir(st.root) == []
+
+    def test_lookup_counts_hit_and_miss(self, tmp_path):
+        st = self._store(tmp_path)
+        h0, m0 = _counter("pagestore.hit"), _counter("pagestore.miss")
+        assert st.lookup("absent.pages") is None
+        w = st.writer("e3.pages")
+        w.write(b"x")
+        w.commit()
+        assert st.lookup("e3.pages") is not None
+        assert _counter("pagestore.hit") == h0 + 1
+        assert _counter("pagestore.miss") == m0 + 1
+
+    def test_stale_fingerprint_lookup_deletes_and_misses(self, tmp_path):
+        st = self._store(tmp_path)
+        w = st.writer("e4.pages", fingerprint=[["s", 1, 2]])
+        w.write(b"x")
+        w.commit()
+        # matching fingerprint: hit, entry stays
+        assert st.lookup("e4.pages", fingerprint=[["s", 1, 2]]) is not None
+        # changed source: the entry is deleted and the lookup misses
+        assert st.lookup("e4.pages", fingerprint=[["s", 9, 2]]) is None
+        assert not st.exists("e4.pages")
+        assert st.stamp("e4.pages") is None
+
+    def test_open_read_missing_is_none(self, tmp_path):
+        st = self._store(tmp_path)
+        assert st.open_read("ghost.pages") is None
+
+    def test_budget_lru_eviction_skips_pinned(self, tmp_path):
+        st = self._store(tmp_path)
+        for i, age in ((0, 100), (1, 200), (2, 300)):
+            w = st.writer(f"e{i}.pages")
+            w.write(b"x" * 100)
+            w.commit()
+            os.utime(st.path(f"e{i}.pages"), (age, age))
+        st.pin("e0.pages")  # the oldest is pinned: must survive
+        e0 = _counter("pagestore.evict")
+        # pinned bytes still count against the budget: to fit 150 the
+        # store must shed BOTH unpinned entries (oldest-first), and the
+        # pinned one survives even though it is the LRU-coldest
+        evicted = st.set_budget(150)
+        assert evicted == 2
+        assert _counter("pagestore.evict") == e0 + 2
+        assert st.exists("e0.pages")       # pinned (oldest)
+        assert not st.exists("e1.pages")   # LRU victim
+        assert not st.exists("e2.pages")
+        st.unpin("e0.pages")
+        assert st.set_budget(10) == 1     # unpinned now: evictable
+        assert not st.exists("e0.pages")
+
+    def test_used_bytes_counts_recognized_entries_only(self, tmp_path):
+        st = self._store(tmp_path)
+        w = st.writer("a.pages")
+        w.write(b"12345")
+        w.commit()
+        os.makedirs(st.root, exist_ok=True)
+        with open(os.path.join(st.root, "alien.bin"), "wb") as f:
+            f.write(b"x" * 1000)  # no .pages suffix, no sidecar
+        assert st.used_bytes() == 5
+
+    def test_for_path_roots_at_directory(self, tmp_path):
+        st, entry = PageStore.for_path(str(tmp_path / "sub" / "c.bin"))
+        assert st.root == str(tmp_path / "sub")
+        assert entry == "c.bin"
+        # same root → same instance
+        st2, _ = PageStore.for_path(str(tmp_path / "sub" / "d.bin"))
+        assert st2 is st
+
+    def test_sweep(self, tmp_path):
+        src = tmp_path / "src.txt"
+        src.write_bytes(b"hello\n")
+        fp = stat_fingerprint([str(src)])
+        stale_fp = [[str(src), fp[0][1] + 7, fp[0][2]]]
+        st = self._store(tmp_path)
+        for name, f in (("fresh.pages", fp), ("stale.pages", stale_fp)):
+            w = st.writer(name, fingerprint=f)
+            w.write(b"x")
+            w.commit()
+        # orphan sidecar (crashed build), old anonymous tmp, alien file
+        with open(st.path("ghost.pages.meta.json"), "w") as f:
+            json.dump({}, f)
+        open(st.path("dead.pages.tmp"), "wb").close()
+        os.utime(st.path("dead.pages.tmp"), (1, 1))
+        with open(st.path("alien.dat"), "wb") as f:
+            f.write(b"not ours")
+        removed = st.sweep()
+        assert removed == 3  # stale entry, orphan sidecar, old tmp
+        assert st.exists("fresh.pages")
+        assert st.stamp("fresh.pages")["fingerprint"] == fp
+        assert not st.exists("stale.pages")
+        assert not os.path.exists(st.path("ghost.pages.meta.json"))
+        assert not os.path.exists(st.path("dead.pages.tmp"))
+        assert os.path.exists(st.path("alien.dat"))
+
+    def test_sweep_removes_dead_owner_entries(self, tmp_path):
+        st = self._store(tmp_path)
+        # a round-spill page named for a pid that cannot be alive
+        name = "rounds-deadbeef-p999999999-1.pages"
+        os.makedirs(st.root, exist_ok=True)
+        with open(st.path(name), "wb") as f:
+            f.write(b"x")
+        assert st.sweep() == 1
+        assert not st.exists(name)
+
+    def test_pin_is_refcounted(self, tmp_path):
+        # two iterators sharing one derived cache path each pin it;
+        # the first one's teardown must NOT expose the entry to
+        # eviction while the second still serves it
+        st = self._store(tmp_path)
+        w = st.writer("shared.pages")
+        w.write(b"x" * 100)
+        w.commit()
+        st.pin("shared.pages")
+        st.pin("shared.pages")
+        st.unpin("shared.pages")   # first iterator dies
+        assert st.set_budget(10) == 0
+        assert st.exists("shared.pages")
+        st.unpin("shared.pages")   # second iterator dies
+        assert st.set_budget(10) == 1
+        st.set_budget(None)
+
+    def test_sweep_skips_pinned_stale_entry(self, tmp_path):
+        src = tmp_path / "s.txt"
+        src.write_bytes(b"v1")
+        st = self._store(tmp_path)
+        w = st.writer("live.pages",
+                      fingerprint=stat_fingerprint([str(src)]))
+        w.write(b"x")
+        w.commit()
+        st.pin("live.pages")
+        src.write_bytes(b"v2-longer")  # source mutated: stamp stale
+        assert st.sweep() == 0         # pinned: the iterator owns it
+        assert st.exists("live.pages")
+        st.unpin("live.pages")
+        assert st.sweep() == 1         # unpinned: swept as stale
+        assert not st.exists("live.pages")
+
+    def test_used_bytes_cache_tracks_commit_and_delete(self, tmp_path):
+        st = self._store(tmp_path)
+        assert st.used_bytes() == 0    # primes the running total
+        for i in range(3):
+            w = st.writer(f"u{i}.pages")
+            w.write(b"x" * 10)
+            w.commit()
+        assert st._used_cache == 30    # O(1) accounting, no rescan
+        st.delete("u0.pages")
+        assert st._used_cache == 20
+        assert st.used_bytes() == 20   # full scan agrees
+
+    def test_sweep_keeps_live_writer_tmp(self, tmp_path):
+        st = self._store(tmp_path)
+        w = st.writer("live.pages")
+        w.write(b"in flight")
+        assert st.sweep() == 0  # our own pid: never reaped
+        w.abort()
+
+
+# ------------------------------------------- cached split staleness
+
+class TestCachedSplitStaleness:
+    def _lines(self, n, tag):
+        return b"\n".join(b"%s-%04d" % (tag, i) for i in range(n)) + b"\n"
+
+    def test_changed_source_reruns_first_pass(self, tmp_path):
+        from dmlc_tpu.io.input_split import InputSplit
+        data = tmp_path / "d.txt"
+        data.write_bytes(self._lines(500, b"old"))
+        uri = f"{data}#{tmp_path / 'c.bin'}"
+        assert list(InputSplit.create(uri, 0, 1)) == \
+            self._lines(500, b"old").splitlines()
+        # the committed cache carries the source stamp
+        stamp_path = str(tmp_path / "c.bin") + ".p0-1.meta.json"
+        with open(stamp_path) as f:
+            assert json.load(f)["fingerprint"][0][0] == str(data)
+        # mutate the source (different size): the old .done-marker
+        # contract would replay stale bytes forever — the stamp must
+        # force a re-run of the first pass instead
+        data.write_bytes(self._lines(600, b"new"))
+        got = list(InputSplit.create(uri, 0, 1))
+        assert got == self._lines(600, b"new").splitlines()
+
+    def test_same_size_mtime_change_reruns(self, tmp_path):
+        from dmlc_tpu.io.input_split import InputSplit
+        data = tmp_path / "d.txt"
+        data.write_bytes(self._lines(100, b"aaa"))
+        uri = f"{data}#{tmp_path / 'c2.bin'}"
+        assert list(InputSplit.create(uri, 0, 1)) == \
+            self._lines(100, b"aaa").splitlines()
+        data.write_bytes(self._lines(100, b"bbb"))  # same byte count
+        os.utime(data, (data.stat().st_atime,
+                        data.stat().st_mtime + 10))
+        got = list(InputSplit.create(uri, 0, 1))
+        assert got == self._lines(100, b"bbb").splitlines()
+
+    def test_unchanged_source_replays_without_rebuild(self, tmp_path):
+        from dmlc_tpu.io.input_split import InputSplit
+        data = tmp_path / "d.txt"
+        data.write_bytes(self._lines(300, b"xyz"))
+        uri = f"{data}#{tmp_path / 'c3.bin'}"
+        list(InputSplit.create(uri, 0, 1))
+        cache = str(tmp_path / "c3.bin") + ".p0-1"
+        before = os.stat(cache).st_mtime_ns
+        h0 = _counter("pagestore.hit")
+        assert list(InputSplit.create(uri, 0, 1)) == \
+            self._lines(300, b"xyz").splitlines()
+        # served from the cache (hit counted), not rebuilt
+        assert _counter("pagestore.hit") > h0
+        assert os.path.getsize(cache) > 0
+        assert os.stat(cache).st_mtime_ns >= before
+
+
+# ------------------------------------------- DiskRowIter stamp contract
+
+class TestDiskRowIterStamp:
+    def test_stamped_cache_rebuilds_on_source_change(self, tmp_path):
+        from dmlc_tpu.data.row_iter import RowBlockIter
+        src = tmp_path / "d.libsvm"
+        src.write_text("1 1:1.0\n0 2:2.0\n" * 50)
+        cache = tmp_path / "cache"
+        uri = f"{src}?format=libsvm#{cache}"
+        it = RowBlockIter.create(uri, 0, 1)
+        it.before_first()
+        assert it.next()
+        first = it.value().label.sum()
+        del it
+        # in-place mutation, same cache hint: the stamp must catch it
+        src.write_text("1 1:1.0\n1 2:2.0\n" * 50)
+        it2 = RowBlockIter.create(uri, 0, 1)
+        it2.before_first()
+        total = 0.0
+        while it2.next():
+            total += it2.value().label.sum()
+        assert total == 100.0  # all-ones labels: the NEW source
+        assert first != total
+        del it2
