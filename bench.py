@@ -8,11 +8,15 @@ CSR views → async jax.device_put into device memory, transfers riding
 under parse via detached leases.
 
 The measured config is BUILT from the declarative pipeline graph
-(dmlc_tpu.pipeline): ``from_uri(...).parse(...).to_device(...)``
-compiles to the same parser + windowed async-transfer machinery the
-pre-r6 hand-wired loop used, with a telemetry probe at each stage
-boundary and the in-flight device window owned by the between-epoch
-autotuner instead of a hard-coded constant. A short hand-wired
+(dmlc_tpu.pipeline): ``from_uri(...).parse(...).batch(pad=True)
+.to_device(...)`` compiles to the parser + ABI-5 native batch assembly
+(bucket-padded device-layout batches emitted straight from the parse
+arena — ``assembly_path`` says which rung served) + windowed async
+transfers through the reusable host staging pair, with a telemetry
+probe at each stage boundary and the in-flight device window owned by
+the between-epoch autotuner instead of a hard-coded constant.
+``DMLC_TPU_BENCH_ASSEMBLY=none`` restores the pre-r7 raw-block config
+for before/after attribution. A short hand-wired
 reference run (DMLC_TPU_BENCH_HANDWIRED_EPOCHS, default 3) reports
 "handwired_gbps" alongside so pipeline overhead stays visible.
 
@@ -22,7 +26,8 @@ Chrome/Perfetto trace-event JSON (per-stage pull spans, queue waits,
 transfer drains, native-engine counter tracks) lands at the given path.
 
 Prints exactly ONE JSON line: {"metric", "value", "unit",
-"vs_baseline", "best_epoch", "epochs", "bound", "parse_cpu_gbps_core",
+"vs_baseline", "best_epoch", "epochs", "bound", "assembly_path",
+"assemble_wait_s", "parse_cpu_gbps_core",
 "sustained_gauge_ok", "gauge_ok_epochs", "gauge_ok_threshold",
 "epoch_gauges", "gauge_bands", "run_band", "replay_gbps", "replay",
 "replay_tier", "handwired_gbps", "pipeline", "metrics", "trace"} —
@@ -190,13 +195,37 @@ def main() -> None:
     # The measured config, built from the declarative graph: same
     # parser, same windowed async transfer — but probed per stage and
     # with the in-flight window an autotuner knob instead of the
-    # constant 4 the hand-wired loop carried.
+    # constant 4 the hand-wired loop carried. Since r7 the steady path
+    # also ASSEMBLES: batch(pad=True) emits fixed-shape device-layout
+    # batches, fused into the engine's ABI-5 native assembly when the
+    # native parser serves (assembly_path="native-padded"; the Python
+    # fused golden otherwise), and to_device routes them through the
+    # host staging pair so transfer N overlaps assembly N+1.
+    # DMLC_TPU_BENCH_ASSEMBLY=none restores the pre-r7 raw-block
+    # config for before/after attribution.
     from dmlc_tpu.pipeline import Pipeline
-    built = (Pipeline.from_uri(DATA)
-             .parse(format="libsvm", engine="auto",
-                    chunk_size=chunk_mb << 20)
-             .to_device(dev, window="auto")
-             .build(autotune=True))
+    assembly_mode = os.environ.get("DMLC_TPU_BENCH_ASSEMBLY", "auto")
+    # DMLC_TPU_BENCH_SHARDS=N (N>1): split the ONE bench file across N
+    # native parsers on aligned byte ranges (ISSUE 7 rung c) — the
+    # single-file workload parallelizes its reader/parse stages like a
+    # multi-file one, byte-identical ordering pinned by tests. Padded
+    # assembly over a sharded parse runs the python-fused rung (a
+    # padded batch may not straddle the shard boundary), so this knob
+    # trades the native-assembly rung for read/parse parallelism —
+    # the right trade whenever cores outnumber the one reader thread.
+    shards = int(os.environ.get("DMLC_TPU_BENCH_SHARDS", "0") or 0)
+    parse_kw = {"shards": shards} if shards > 1 else {}
+    pl = (Pipeline.from_uri(DATA)
+          .parse(format="libsvm", engine="auto",
+                 chunk_size=chunk_mb << 20, **parse_kw))
+    if assembly_mode != "none":
+        rows_pb = int(os.environ.get("DMLC_TPU_BENCH_BATCH_ROWS",
+                                     str(8 << 10)))
+        # worst-case nnz bound: ensure_data rows carry < 45 features
+        nnz_pb = int(os.environ.get("DMLC_TPU_BENCH_NNZ_BUCKET",
+                                    str(rows_pb * 45)))
+        pl = pl.batch(rows_pb, pad=True, nnz_bucket=nnz_pb)
+    built = pl.to_device(dev, window="auto").build(autotune=True)
 
     def epoch():
         for _ in built:
@@ -205,9 +234,23 @@ def main() -> None:
         parse_st = snap["stages"][0]
         dev_st = snap["stages"][-1]
         t_pull = parse_st["wait_s"]
-        t_xfer = (dev_st.get("extra") or {}).get("xfer_wait_s", 0.0)
-        stats = (parse_st.get("extra") or {}).get("engine")
-        return (snap["wall_s"], t_pull, t_xfer, parse_st["rows"],
+        dx = dev_st.get("extra") or {}
+        t_xfer = dx.get("xfer_wait_s", 0.0)
+        # assemble-wait: pad+stack memcpy seconds this epoch — the
+        # engine's consumer-side assemble_ns on the fused native rung
+        # (where parse+assemble are ONE stage), the measured pad_single
+        # time on the python rung (its own stage), plus the host
+        # staging copies (device.assemble spans) when staging runs.
+        # Scanned across stages: the fused path folds assembly into
+        # stages[0], the fallback carries it on its own stage.
+        t_asm = dx.get("staging_assemble_s", 0.0)
+        stats = None
+        for st in snap["stages"]:
+            x = st.get("extra") or {}
+            t_asm += x.get("assemble_s", 0.0)
+            if stats is None:
+                stats = x.get("engine")
+        return (snap["wall_s"], t_pull, t_xfer, t_asm, parse_st["rows"],
                 parse_st["nnz"], stats, snap)
 
     # Sustained measurement (VERDICT r2 #2): run at least min_epochs
@@ -246,20 +289,22 @@ def main() -> None:
     times = []   # (wall_s, gauge_gbps) per epoch
     best = None
     best_stats = None
-    best_waits = (0.0, 0.0)
+    best_waits = (0.0, 0.0, 0.0)
     best_snap = None
     best_metrics = None
     t_start = time.perf_counter()
     i = 0
     while True:
         gauge = memcpy_gauge()
-        dt, t_pull, t_xfer, rows, nnz, stats, snap = epoch()
+        dt, t_pull, t_xfer, t_asm, rows, nnz, stats, snap = epoch()
         times.append((dt, gauge))
         log(f"epoch {i}: rows={rows} nnz={nnz} wall={dt:.2f}s "
             f"pull-wait={t_pull:.2f}s xfer-wait={t_xfer:.2f}s "
+            f"assemble-wait={t_asm:.2f}s "
             f"gauge={gauge:.2f} -> {size / dt / 1e9:.3f} GB/s")
         if best is None or dt < best:
-            best, best_stats, best_waits = dt, stats, (t_pull, t_xfer)
+            best, best_stats = dt, stats
+            best_waits = (t_pull, t_xfer, t_asm)
             best_snap = snap
             # the registry snapshot AT the best epoch: queue
             # collectors, engine counters, profiler aggregates — the
@@ -414,12 +459,22 @@ def main() -> None:
     # either waits on the parser (parse-bound) or on device transfers
     # (transfer-bound). On this box the transfer side is the tunnel's
     # burst shaping — see dmlc_tpu.bench_transfer / BASELINE.md.
-    pull_s, xfer_s = best_waits
+    pull_s, xfer_s, asm_s = best_waits
     bound = "transfer" if xfer_s > pull_s else "parse"
+    # which rung assembled the measured batches: "native-padded"
+    # (engine ABI-5), "python-fused" (pad_single golden) or "none"
+    # (DMLC_TPU_BENCH_ASSEMBLY=none, the pre-r7 raw-block config)
+    assembly_path = "none"
+    if best_snap:
+        assembly_path = next(
+            (x["assembly_path"] for s in best_snap["stages"]
+             if (x := s.get("extra") or {}).get("assembly_path")),
+            "none")
     log(f"sustained (trimmed mean of {len(times)} epochs) = "
         f"{sustained:.3f} GB/s; best epoch = {best_gbps:.3f} GB/s; "
         f"bound={bound} (pull-wait {pull_s:.2f}s vs xfer-wait "
-        f"{xfer_s:.2f}s in best epoch)")
+        f"{xfer_s:.2f}s vs assemble-wait {asm_s:.2f}s in best epoch); "
+        f"assembly_path={assembly_path}")
     print(json.dumps({
         "metric": "libsvm_parse_to_hbm_throughput",
         "value": round(sustained, 4),
@@ -428,6 +483,13 @@ def main() -> None:
         "best_epoch": round(best_gbps, 4),
         "epochs": len(times),
         "bound": bound,
+        # which rung assembled the measured batches (r7): attributes
+        # campaign wins to native-padded vs python-fused vs the pre-r7
+        # raw-block config; assemble_wait_s is the best epoch's
+        # pad+stack memcpy seconds (engine assemble_ns or pad_single
+        # time, plus host staging copies)
+        "assembly_path": assembly_path,
+        "assemble_wait_s": round(asm_s, 4),
         # null when the engine exposes no thread-CPU stats (python
         # fallback) — the key is always present for consumers
         "parse_cpu_gbps_core": (round(parse_cpu_gbps, 4)

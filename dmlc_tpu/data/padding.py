@@ -1,0 +1,240 @@
+"""Bucket padding: CSR RowBlocks → fixed-shape, device-layout batches.
+
+The one home of the padded-batch layout contract, shared by THREE
+producers that must agree byte for byte (tests pin it):
+
+- ``pad_single`` / ``pad_to_bucket``: the Python golden — one block →
+  one padded dict (``pad_single`` is the fused one-pass form the
+  pipeline's ``batch(pad=True)`` fallback uses).
+- ``stack_padded_rows``: the fused multi-block pad+stack serving
+  ShardedRowBlockIter's replay rounds (one ``[L, ...]`` array per key).
+- the native engine's ABI-5 ``dtp_parser_next_padded``
+  (``native/src/engine.cc`` NextPadded), which emits the same layout
+  directly from the parse arena so Python never touches row bytes.
+
+Layout (row_bucket = rb, nnz_bucket = nb):
+  offset  [rb+1] int64 — rebased to the batch, pad tail repeats num_nnz
+  label   [rb]   f32   — pad 0
+  weight  [rb]   f32   — absent weights fill 1, pad 0
+  index   [nb]   u32/u64 (block dtype) — pad 0
+  value   [nb]   f32   — absent values fill 1, pad 0
+  qid     [rb]   int64 — fill/pad -1; present iff some row's qid != -1
+                         (RowBlockContainer's value-based rule) or the
+                         caller forces it (``want_qid``)
+  field   [nb]   int64 — fill/pad 0; present iff a constituent block
+                         carried fields or ``want_field``
+  num_rows/num_nnz     — true sizes under the padding (int32)
+
+Padded rows are compute-neutral: weight 0, empty (offset repeats);
+padded nnz carry index 0, value 0.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from dmlc_tpu.data.rowblock import RowBlock
+from dmlc_tpu.utils.logging import check, check_le
+
+__all__ = ["pad_to_bucket", "ensure_schema", "stack_padded_rows",
+           "pad_single", "PaddedBatch"]
+
+
+class PaddedBatch(dict):
+    """A padded-batch dict that can carry a native-engine lease.
+
+    The ABI-5 padded path yields ZERO-COPY views into a leased padded
+    block; downstream stages (prefetch, to_device) apply the exact
+    RowBlock lease discipline, so the dict needs the same ``lease``
+    attribute slot. ``copy()`` materializes owned arrays (the dict
+    ``copy()`` would alias the leased views)."""
+
+    __slots__ = ("lease",)
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.lease = None
+
+    def copy(self) -> "PaddedBatch":
+        out = PaddedBatch({k: np.array(v, copy=True)
+                           for k, v in self.items()})
+        return out
+
+
+def pad_to_bucket(block: RowBlock, row_bucket: int,
+                  nnz_bucket: int) -> Dict[str, np.ndarray]:
+    """CSR RowBlock → fixed-shape numpy dict (padded, compute-neutral).
+
+    Keys: offset[row_bucket+1] int64, label/weight[row_bucket] f32,
+    index[nnz_bucket] (block dtype), value[nnz_bucket] f32,
+    num_rows/num_nnz scalars int32. Padded rows are empty (offset
+    repeats) with weight 0; padded nnz carry index 0, value 0.
+    """
+    n, nnz = block.size, block.nnz
+    check_le(n, row_bucket, "row bucket too small")
+    check_le(nnz, nnz_bucket, "nnz bucket too small")
+    offset = np.full(row_bucket + 1, nnz, np.int64)
+    offset[:n + 1] = block.offset
+    label = np.zeros(row_bucket, np.float32)
+    label[:n] = block.label
+    weight = np.zeros(row_bucket, np.float32)
+    weight[:n] = block.weight if block.weight is not None else 1.0
+    index = np.zeros(nnz_bucket, block.index.dtype)
+    index[:nnz] = block.index
+    value = np.zeros(nnz_bucket, np.float32)
+    if block.value is not None:
+        value[:nnz] = block.value
+    else:
+        value[:nnz] = 1.0
+    out = {"offset": offset, "label": label, "weight": weight,
+           "index": index, "value": value,
+           "num_rows": np.int32(n), "num_nnz": np.int32(nnz)}
+    if block.qid is not None:
+        qid = np.full(row_bucket, -1, np.int64)
+        qid[:n] = block.qid
+        out["qid"] = qid
+    if block.field is not None:
+        field = np.zeros(nnz_bucket, np.int64)
+        field[:nnz] = block.field
+        out["field"] = field
+    return out
+
+
+def ensure_schema(padded: Dict[str, np.ndarray], row_bucket: int,
+                  nnz_bucket: int, want_qid: bool,
+                  want_field: bool) -> Dict[str, np.ndarray]:
+    """Force the optional qid/field keys onto a padded dict that lacks
+    them (qid pads -1, field pads 0 — the same neutral values
+    pad_to_bucket uses under real data). Every dict in a stacked round
+    must carry ONE key set; without this, a part that exhausts before
+    the global round count pads with key-less empty blocks and
+    stack_device_batches raises on qid/field-bearing sources (ADVICE
+    r4)."""
+    if want_qid and "qid" not in padded:
+        padded["qid"] = np.full(row_bucket, -1, np.int64)
+    if want_field and "field" not in padded:
+        padded["field"] = np.zeros(nnz_bucket, np.int64)
+    return padded
+
+
+def pad_single(block: RowBlock, row_bucket: int, nnz_bucket: int,
+               want_qid: bool = False,
+               want_field: bool = False) -> PaddedBatch:
+    """pad_to_bucket + ensure_schema fused into one pass — the Python
+    golden for the native engine's ABI-5 padded block (byte parity
+    pinned by tests/test_native_assembly.py). Writes each element once
+    (data prefix + neutral-pad tail) instead of fill-then-overwrite."""
+    n, nnz = block.size, block.nnz
+    check_le(n, row_bucket, "row bucket too small")
+    check_le(nnz, nnz_bucket, "nnz bucket too small")
+    rb, nb = row_bucket, nnz_bucket
+    offset = np.empty(rb + 1, np.int64)
+    offset[:n + 1] = block.offset
+    offset[n + 1:] = nnz
+    label = np.empty(rb, np.float32)
+    label[:n] = block.label
+    label[n:] = 0.0
+    weight = np.empty(rb, np.float32)
+    weight[:n] = block.weight if block.weight is not None else 1.0
+    weight[n:] = 0.0
+    index = np.empty(nb, block.index.dtype)
+    index[:nnz] = block.index
+    index[nnz:] = 0
+    value = np.empty(nb, np.float32)
+    value[:nnz] = block.value if block.value is not None else 1.0
+    value[nnz:] = 0.0
+    out = PaddedBatch({"offset": offset, "label": label,
+                       "weight": weight, "index": index, "value": value,
+                       "num_rows": np.int32(n), "num_nnz": np.int32(nnz)})
+    if block.qid is not None or want_qid:
+        qid = np.empty(rb, np.int64)
+        qid[:n] = block.qid if block.qid is not None else -1
+        qid[n:] = -1
+        out["qid"] = qid
+    if block.field is not None or want_field:
+        field = np.empty(nb, np.int64)
+        field[:nnz] = block.field if block.field is not None else 0
+        field[nnz:] = 0
+        out["field"] = field
+    return out
+
+
+def stack_padded_rows(blocks: List[RowBlock], row_bucket: int,
+                      nnz_bucket: int, want_qid: bool = False,
+                      want_field: bool = False) -> Dict[str, np.ndarray]:
+    """pad_to_bucket + ensure_schema + stack_device_batches fused into
+    ONE pass: the stacked [L, ...] arrays are allocated directly and
+    each device's slice written in place — no per-device intermediate
+    arrays, no np.stack copy. Byte-identical to the composed path
+    (pinned by test_fused_stack_matches_composed_path); this is the
+    serve-thread hot loop of steady replay, where every written byte is
+    throughput off the page tier, so it writes each element once
+    (data prefix + neutral-pad tail) instead of fill-then-overwrite.
+
+    Zero-copy fast path: a single-part round (L == 1, the every-test
+    one-device mesh and the single-chip bench shape) whose block is
+    ALREADY exactly bucket-sized serves reshaped VIEWS of the block's
+    own arrays instead of re-padding — on page replay every round would
+    otherwise pay a full pad memcpy that writes the same bytes it read.
+    RowBlock is immutable by contract and the replay tiers serve blocks
+    that are only ever read, so aliasing is safe; blocks still carrying
+    a native-arena lease are excluded (their buffers get recycled)."""
+    L = len(blocks)
+    check(L > 0, "no device batches")
+    has_qid = want_qid or any(b.qid is not None for b in blocks)
+    has_field = want_field or any(b.field is not None for b in blocks)
+    rb, nb = row_bucket, nnz_bucket
+    if L == 1:
+        b = blocks[0]
+        if (b.size == rb and b.nnz == nb and b.lease is None
+                and b.weight is not None and b.value is not None
+                and (b.qid is not None or not has_qid)
+                and (b.field is not None or not has_field)):
+            out = {"offset": b.offset[None], "label": b.label[None],
+                   "weight": b.weight[None], "index": b.index[None],
+                   "value": b.value[None],
+                   "num_rows": np.asarray([rb], np.int32),
+                   "num_nnz": np.asarray([nb], np.int32)}
+            if has_qid:
+                out["qid"] = b.qid[None]
+            if has_field:
+                out["field"] = b.field[None]
+            return out
+    out = {
+        "offset": np.empty((L, rb + 1), np.int64),
+        "label": np.empty((L, rb), np.float32),
+        "weight": np.empty((L, rb), np.float32),
+        "index": np.empty((L, nb), blocks[0].index.dtype),
+        "value": np.empty((L, nb), np.float32),
+        "num_rows": np.empty(L, np.int32),
+        "num_nnz": np.empty(L, np.int32),
+    }
+    if has_qid:
+        out["qid"] = np.empty((L, rb), np.int64)
+    if has_field:
+        out["field"] = np.empty((L, nb), np.int64)
+    for i, b in enumerate(blocks):
+        n, nnz = b.size, b.nnz
+        check_le(n, rb, "row bucket too small")
+        check_le(nnz, nb, "nnz bucket too small")
+        out["offset"][i, :n + 1] = b.offset
+        out["offset"][i, n + 1:] = nnz
+        out["label"][i, :n] = b.label
+        out["label"][i, n:] = 0.0
+        out["weight"][i, :n] = b.weight if b.weight is not None else 1.0
+        out["weight"][i, n:] = 0.0
+        out["index"][i, :nnz] = b.index
+        out["index"][i, nnz:] = 0
+        out["value"][i, :nnz] = b.value if b.value is not None else 1.0
+        out["value"][i, nnz:] = 0.0
+        out["num_rows"][i] = n
+        out["num_nnz"][i] = nnz
+        if has_qid:
+            out["qid"][i, :n] = b.qid if b.qid is not None else -1
+            out["qid"][i, n:] = -1
+        if has_field:
+            out["field"][i, :nnz] = b.field if b.field is not None else 0
+            out["field"][i, nnz:] = 0
+    return out

@@ -32,6 +32,12 @@ __all__ = ["DataIter", "Parser", "TextParserBase", "PARSER_REGISTRY",
 PARSER_REGISTRY = Registry.get("ParserFactory")
 
 
+# native_or's class-name → format-string map for the sharded dispatch
+_NATIVE_FORMATS = {"NativeLibSVMParser": "libsvm",
+                   "NativeCSVParser": "csv",
+                   "NativeLibFMParser": "libfm"}
+
+
 def native_or(native_cls_name: str, python_cls, kwargs):
     """Shared engine dispatch for text-format factories.
 
@@ -39,8 +45,31 @@ def native_or(native_cls_name: str, python_cls, kwargs):
     Python golden for URIs it cannot serve (stdin, '#cache', remote
     schemes). engine="native": require it, re-raising any failure.
     engine="python": golden only.
+
+    ``shards=N`` (N > 1, whole-input reads only) splits one input
+    across N independent native parsers on byte ranges with
+    deterministic in-order block reassembly
+    (bindings.NativeShardedTextParser) — a single large file then
+    parallelizes its reader/reorder stages like a multi-file input,
+    byte-identical to the 1-parser stream. The python golden (and a
+    part of a wider split) runs unsharded — shards is a pure
+    performance knob, never a semantics change.
     """
     engine = kwargs.get("engine", "auto")
+    shards = int(kwargs.pop("shards", 1) or 1)
+    if shards > 1 and (kwargs.get("part_index", 0) != 0
+                       or kwargs.get("num_parts", 1) != 1):
+        # an outer part/num_parts split already subdivides the input;
+        # nesting the shard split would apply the byte-range alignment
+        # rule twice with different steps (ranges stop concatenating to
+        # the outer part) — run the part unsharded instead
+        from dmlc_tpu.obs.log import warn_limited
+        warn_limited(
+            "parser-shards-nested",
+            f"shards={shards} ignored under a part/num_parts split "
+            "(sharded parse serves whole inputs only); running the "
+            "part unsharded", min_interval_s=60.0)
+        shards = 1
     # python-only construction kwargs (pipeline seam): the native engine
     # runs its own reader/queue pipeline, so a custom split forces the
     # python golden and the chunk-prefetch depth simply does not apply
@@ -53,6 +82,12 @@ def native_or(native_cls_name: str, python_cls, kwargs):
                 nat_kwargs = {k: v for k, v in kwargs.items()
                               if k not in ("prefetch_depth",
                                            "split_factory")}
+                if (shards > 1
+                        and nat_kwargs.get("part_index", 0) == 0
+                        and nat_kwargs.get("num_parts", 1) == 1):
+                    nat_kwargs["shards"] = shards
+                    nat_kwargs["format"] = _NATIVE_FORMATS[native_cls_name]
+                    return bindings.NativeShardedTextParser(**nat_kwargs)
                 return getattr(bindings, native_cls_name)(**nat_kwargs)
             except (DMLCError, FileNotFoundError, OSError):
                 if engine == "native":
@@ -62,6 +97,13 @@ def native_or(native_cls_name: str, python_cls, kwargs):
     elif engine == "native" and has_custom_split:
         raise DMLCError("native engine does not accept split_factory; "
                         "use engine='python' for injected splits")
+    if shards > 1:
+        from dmlc_tpu.obs.log import warn_limited
+        warn_limited(
+            "parser-shards-ignored",
+            f"shards={shards} ignored: the sharded single-input parse "
+            "needs the native engine over the whole input "
+            "(part 0 of 1); running unsharded", min_interval_s=60.0)
     return python_cls(**kwargs)
 
 

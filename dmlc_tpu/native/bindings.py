@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from dmlc_tpu.data.padding import PaddedBatch
 from dmlc_tpu.data.parser import Parser
 from dmlc_tpu.data.rowblock import RowBlock
 from dmlc_tpu.io.input_split import list_split_files
@@ -24,7 +25,8 @@ from dmlc_tpu.obs.metrics import REGISTRY as _METRICS
 from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
-           "NativeCSVParser", "NativeLibFMParser", "NativeRecordIOReader",
+           "NativeCSVParser", "NativeLibFMParser",
+           "NativeShardedTextParser", "NativeRecordIOReader",
            "NativeIndexedRecordIOReader", "native_parse_float32",
            "columns_interleave"]
 
@@ -32,8 +34,10 @@ _lib = None
 
 # Must equal dtp_version() in engine.cc. Bumped on every C ABI signature
 # change (3: dtp_parser_create grew the `sparse` argument; 4: span-ring
-# trace surface).
-ABI_VERSION = 4
+# trace surface; 5: native batch assembly — dtp_parser_next_padded /
+# dtp_padded_release / dtp_parser_start / dtp_parser_outstanding, and
+# dtp_parser_stats grew to 8 slots).
+ABI_VERSION = 5
 
 
 def load(path: str):
@@ -69,6 +73,25 @@ def load(path: str):
         C.POINTER(C.c_int64),               # nnz
         C.POINTER(C.c_int), C.POINTER(C.c_int), C.POINTER(C.c_int),
     ]
+    lib.dtp_parser_next_padded.restype = C.c_int64
+    lib.dtp_parser_next_padded.argtypes = [
+        C.c_void_p, C.c_int64, C.c_int64, C.c_int64, C.c_int, C.c_int,
+        C.POINTER(C.c_void_p),              # padded-block lease handle
+        C.POINTER(C.POINTER(C.c_int64)),    # offset  [row_bucket+1]
+        C.POINTER(C.POINTER(C.c_float)),    # label   [row_bucket]
+        C.POINTER(C.POINTER(C.c_float)),    # weight  [row_bucket]
+        C.POINTER(C.POINTER(C.c_float)),    # value   [nnz_bucket]
+        C.POINTER(C.POINTER(C.c_uint32)),   # index32 [nnz_bucket]
+        C.POINTER(C.POINTER(C.c_uint64)),   # index64 [nnz_bucket]
+        C.POINTER(C.POINTER(C.c_int64)),    # qid     [row_bucket]
+        C.POINTER(C.POINTER(C.c_int64)),    # field   [nnz_bucket]
+        C.POINTER(C.c_int64),               # num_nnz
+        C.POINTER(C.c_int), C.POINTER(C.c_int), C.POINTER(C.c_int),
+    ]
+    lib.dtp_padded_release.argtypes = [C.c_void_p, C.c_void_p]
+    lib.dtp_parser_start.argtypes = [C.c_void_p]
+    lib.dtp_parser_outstanding.restype = C.c_int64
+    lib.dtp_parser_outstanding.argtypes = [C.c_void_p]
     lib.dtp_parser_before_first.argtypes = [C.c_void_p]
     lib.dtp_block_release.argtypes = [C.c_void_p, C.c_void_p]
     lib.dtp_block_index_range.argtypes = [
@@ -214,6 +237,15 @@ class BlockLease:
             pass
 
 
+class _PaddedLease(BlockLease):
+    """Lease over one ABI-5 padded device-layout block (the buffers
+    return to the handle's padded pool on release)."""
+
+    __slots__ = ()
+
+    _release_fn = "dtp_padded_release"
+
+
 # native span ring (engine.cc SpanRing): event kind -> (ph, timeline
 # name); "X" = complete span, "i" = instant. The engine's small thread
 # ids are offset into their own track range so they can never collide
@@ -303,6 +335,20 @@ class NativeTextParser(Parser):
                    C.c_int64(),              # nnz
                    C.c_int(), C.c_int(), C.c_int())
         self._refs = tuple(C.byref(x) for x in self._o)
+        # padded-batch out-params (ABI 5), same allocate-once discipline
+        self._p = (C.c_void_p(),             # padded-block lease
+                   C.POINTER(C.c_int64)(),   # offset
+                   C.POINTER(C.c_float)(),   # label
+                   C.POINTER(C.c_float)(),   # weight
+                   C.POINTER(C.c_float)(),   # value
+                   C.POINTER(C.c_uint32)(),  # index32
+                   C.POINTER(C.c_uint64)(),  # index64
+                   C.POINTER(C.c_int64)(),   # qid
+                   C.POINTER(C.c_int64)(),   # field
+                   C.c_int64(),              # num_nnz
+                   C.c_int(), C.c_int(), C.c_int())
+        self._prefs = tuple(C.byref(x) for x in self._p)
+        self._mode: Optional[str] = None  # "blocks" | "padded" per epoch
 
     # format knobs; subclasses override
     _indexing_mode = 0
@@ -330,8 +376,15 @@ class NativeTextParser(Parser):
             self._lease = None
         self._lib.dtp_parser_before_first(self._handle)
         self._block = None
+        self._mode = None
 
     def next(self) -> bool:
+        if self._mode == "padded":
+            raise DMLCError(
+                "native parser: next() after next_padded() within one "
+                "epoch — rows already cut into the padded carry would "
+                "be skipped; call before_first() first")
+        self._mode = "blocks"
         if self._lease is not None:  # standard RowBlock lifetime contract
             self._lease.release()
             self._lease = None
@@ -382,6 +435,90 @@ class NativeTextParser(Parser):
         check(self._block is not None, "value() before successful next()")
         return self._block
 
+    def next_padded(self, rows: int, row_bucket: Optional[int] = None,
+                    nnz_bucket: int = 0, want_qid: bool = False,
+                    want_field: bool = False
+                    ) -> Optional[Dict[str, np.ndarray]]:
+        """One bucket-padded, device-layout batch assembled IN THE
+        ENGINE (ABI 5): up to ``rows`` rows cut from the arena stream,
+        padded to (row_bucket, nnz_bucket) with the Python fused
+        golden's exact field set, dtypes, neutral pad values and offset
+        rebasing (data/padding.py pad_single — byte parity pinned by
+        tests/test_native.py). Returns a dict of ZERO-COPY views into
+        the leased padded block — valid until the next
+        next_padded()/before_first() (or hold via ``detach()``) — or
+        None at end of stream (the last batch may be short:
+        num_rows < rows). The source arenas are recycled the moment a
+        batch is cut, so Python never holds row bytes on this path.
+        The pad+stack memcpy runs with the GIL released (ctypes)."""
+        if self._mode == "blocks":
+            raise DMLCError(
+                "native parser: next_padded() after next() within one "
+                "epoch — the padded carry would skip the leased block's "
+                "rows; call before_first() first")
+        self._mode = "padded"
+        if self._lease is not None:  # same lifetime contract as next()
+            self._lease.release()
+            self._lease = None
+        rb = rows if row_bucket is None else row_bucket
+        n = self._lib.dtp_parser_next_padded(
+            self._handle, rows, rb, nnz_bucket,
+            1 if want_qid else 0, 1 if want_field else 0, *self._prefs)
+        (block, offset, label, weight, value, index32, index64, qid,
+         field, num_nnz, wide, has_qid, has_field) = self._p
+        if n < 0:
+            self._block = None
+            raise DMLCError(
+                f"{self._format}: {self._lib.dtp_last_error().decode()}")
+        if n == 0:
+            return None
+        z = int(num_nnz.value)
+        lease = _PaddedLease(self, block.value)
+
+        def arr(ptr, count, dtype):
+            if count == 0:
+                return np.empty(0, dtype)
+            return np.ctypeslib.as_array(ptr, shape=(count,))
+
+        nb = int(nnz_bucket)
+        if wide.value:
+            index = arr(index64, nb, np.uint64)
+        else:
+            index = arr(index32, nb, np.uint32)
+        if self.index_dtype != index.dtype:
+            index = index.astype(self.index_dtype)
+        # a PaddedBatch (not a plain dict): downstream stages attach
+        # the detached lease to the item itself (prefetch's
+        # release-on-next-pull discipline needs the ``lease`` slot)
+        out = PaddedBatch(
+            {"offset": arr(offset, rb + 1, np.int64),
+             "label": arr(label, rb, np.float32),
+             "weight": arr(weight, rb, np.float32),
+             "index": index,
+             "value": arr(value, nb, np.float32),
+             "num_rows": np.int32(n), "num_nnz": np.int32(z)})
+        if has_qid.value:
+            out["qid"] = arr(qid, rb, np.int64)
+        if has_field.value:
+            out["field"] = arr(field, nb, np.int64)
+        self._lease = lease
+        self._block = None
+        return out
+
+    def start(self) -> None:
+        """Kick the parse pipeline without consuming a block (reader +
+        workers run ahead immediately). Used by NativeShardedTextParser
+        so every byte-range sub-parser fills its bounded window while
+        the consumer drains them in order. No-op while running."""
+        self._lib.dtp_parser_start(self._handle)
+
+    def outstanding(self) -> int:
+        """Leases currently held by consumers (CSR arenas + padded
+        blocks) — the leak probe: after padded emission the source
+        arenas must be back in the free list even while padded leases
+        are still held (tests/test_native.py pins it)."""
+        return int(self._lib.dtp_parser_outstanding(self._handle))
+
     def detach(self) -> Optional[BlockLease]:
         """Take ownership of the current block's lease: the parser will
         NOT release it on the next next()/before_first(). The caller must
@@ -393,17 +530,19 @@ class NativeTextParser(Parser):
     def stats(self) -> Dict[str, int]:
         """Pipeline stage timings of the current/last run (ns): reader
         busy, parse busy (wall, summed over workers), wall, chunk count,
-        queue depths, and parse CPU (thread CPU time, summed — the honest
+        queue depths, parse CPU (thread CPU time, summed — the honest
         per-core kernel rate: wall inflates when workers are preempted,
-        e.g. by the consumer on a 1-core host). reader+parse > wall
-        proves stage overlap."""
-        out = (C.c_int64 * 7)()
+        e.g. by the consumer on a 1-core host), and padded-batch
+        assemble time (ABI 5: consumer-side pad+stack memcpy, queue
+        waits excluded). reader+parse > wall proves stage overlap."""
+        out = (C.c_int64 * 8)()
         self._lib.dtp_parser_stats(self._handle, out)
         return {"reader_busy_ns": int(out[0]), "parse_busy_ns": int(out[1]),
                 "wall_ns": int(out[2]), "chunks": int(out[3]),
                 "max_chunk_queue_depth": int(out[4]),
                 "max_reorder_depth": int(out[5]),
-                "parse_cpu_ns": int(out[6])}
+                "parse_cpu_ns": int(out[6]),
+                "assemble_ns": int(out[7])}
 
     def drain_trace(self, rec) -> int:
         """Drain this parser's native span ring into a
@@ -732,3 +871,133 @@ class NativeCSVParser(NativeTextParser):
 
 class NativeLibFMParser(NativeTextParser):
     _format = "libfm"
+
+
+_SHARDED_FORMATS = {"libsvm": NativeLibSVMParser, "csv": NativeCSVParser,
+                    "libfm": NativeLibFMParser}
+
+
+class NativeShardedTextParser(Parser):
+    """Single-file parse sharded across N native parsers on byte ranges.
+
+    One large file bounds the steady path by ONE reader thread and ONE
+    consumer-side ordered queue however many parse workers run. This
+    parser splits the WHOLE input across ``shards`` independent native
+    parsers using the standard InputSplit partition rule (sub-parser j
+    is part j of ``shards``, so the aligned byte ranges concatenate to
+    exactly the whole input — the same realignment contract the Python
+    golden and the engine already share), kicks every sub-pipeline at
+    epoch start (``dtp_parser_start``), and reassembles blocks by
+    draining the sub-parsers in shard order. Each sub-parser's bounded
+    reorder window holds its early blocks, so all shards read and parse
+    concurrently while the emitted stream stays BYTE-IDENTICAL to the
+    1-parser stream (pinned by tests/test_native.py).
+
+    Serves the whole input only (part 0 of 1): nesting an outer
+    part/num_parts split and the inner shard split would apply the
+    byte-range alignment rule twice with different step sizes, yielding
+    ranges that no longer concatenate to the outer part.
+    """
+
+    def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1,
+                 shards: int = 2, format: str = "libsvm",
+                 index_dtype=np.uint32, nthreads: Optional[int] = None,
+                 chunk_size: int = 8 << 20, **kwargs: Any):
+        check(part_index == 0 and num_parts == 1,
+              "NativeShardedTextParser serves the whole input "
+              "(part 0 of 1); shard the file via `shards=` only")
+        cls = _SHARDED_FORMATS.get(format)
+        check(cls is not None,
+              f"NativeShardedTextParser: unsupported format {format!r}")
+        self.uri = uri
+        self.index_dtype = np.dtype(index_dtype)
+        self.shards = max(1, int(shards))
+        if nthreads is None:
+            nthreads = max(1, (os.cpu_count() or 1) - 1)
+        per = max(1, int(nthreads) // self.shards)
+        self._subs: List[NativeTextParser] = [
+            cls(uri, j, self.shards, index_dtype=index_dtype,
+                nthreads=per, chunk_size=chunk_size, **dict(kwargs))
+            for j in range(self.shards)]
+        self._cur = 0
+        self._started = False
+        self._block: Optional[RowBlock] = None
+        self._block_sub: Optional[NativeTextParser] = None
+
+    def _start_all(self) -> None:
+        for p in self._subs:
+            p.start()
+        self._started = True
+
+    def before_first(self) -> None:
+        for p in self._subs:
+            p.before_first()
+        self._cur = 0
+        self._block = None
+        self._block_sub = None
+        # restart every sub-pipeline NOW: shard j's reader/workers fill
+        # its bounded window while the consumer is still draining j-1
+        self._start_all()
+
+    def next(self) -> bool:
+        if not self._started:
+            self._start_all()
+        while self._cur < len(self._subs):
+            p = self._subs[self._cur]
+            if p.next():
+                self._block = p.value()
+                self._block_sub = p
+                return True
+            self._cur += 1
+        self._block = None
+        self._block_sub = None
+        return False
+
+    def value(self) -> RowBlock:
+        check(self._block is not None, "value() before successful next()")
+        return self._block
+
+    def detach(self) -> Optional[BlockLease]:
+        return (self._block_sub.detach()
+                if self._block_sub is not None else None)
+
+    def stats(self) -> Dict[str, int]:
+        """Summed busy/cpu/chunk/assemble counters over the sub-parsers
+        (they run concurrently, so summed busy vs the max wall proves
+        the cross-shard overlap); depths are maxima."""
+        outs = [p.stats() for p in self._subs]
+        agg = {k: sum(o[k] for o in outs)
+               for k in ("reader_busy_ns", "parse_busy_ns", "chunks",
+                         "parse_cpu_ns", "assemble_ns")}
+        agg["wall_ns"] = max(o["wall_ns"] for o in outs)
+        agg["max_chunk_queue_depth"] = max(
+            o["max_chunk_queue_depth"] for o in outs)
+        agg["max_reorder_depth"] = max(
+            o["max_reorder_depth"] for o in outs)
+        agg["shards"] = self.shards
+        return agg
+
+    def drain_trace(self, rec) -> int:
+        # sub-parser span rings share one engine tid range, so their
+        # events land on the same named native tracks — one timeline,
+        # shard attribution via the per-span seq args
+        return sum(p.drain_trace(rec) for p in self._subs)
+
+    def outstanding(self) -> int:
+        return sum(p.outstanding() for p in self._subs)
+
+    def bytes_read(self) -> int:
+        return sum(p.bytes_read() for p in self._subs)
+
+    def destroy(self) -> None:
+        for p in self._subs:
+            p.destroy()
+        self._subs = []
+        self._block = None
+        self._block_sub = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass

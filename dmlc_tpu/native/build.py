@@ -26,7 +26,37 @@ def build(verbose: bool = True) -> str:
     if verbose:
         print("+", " ".join(cmd))
     subprocess.run(cmd, check=True)
+    _check_abi(OUT)
     return OUT
+
+
+def _check_abi(path: str) -> None:
+    """Fail the build — loudly, at build time — when the freshly
+    compiled engine does not speak the ABI the bindings expect. Without
+    this a stale source tree produces a .so that bindings.load()
+    rejects at first use, and engine="auto" callers silently fall back
+    to the python golden: the perf regression shows up in BENCH numbers
+    instead of in the build.
+
+    The probe runs in a SUBPROCESS: dlopen in this process would
+    resolve the path to an already-mapped old copy (a REPL that used
+    bindings before rebuilding) and fail a perfectly good rebuild."""
+    from dmlc_tpu.native.bindings import ABI_VERSION
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import ctypes, sys; lib = ctypes.CDLL(sys.argv[1]); "
+         "lib.dtp_version.restype = ctypes.c_int; "
+         "print(lib.dtp_version())", path],
+        capture_output=True, text=True, timeout=60)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"built {path} failed the ABI probe: {out.stderr.strip()}")
+    got = int(out.stdout.strip())
+    if got != ABI_VERSION:
+        raise RuntimeError(
+            f"built {path} speaks ABI {got}, bindings expect "
+            f"{ABI_VERSION} — src/engine.cc and bindings.py are out of "
+            "sync (bump dtp_version()/ABI_VERSION together)")
 
 
 if __name__ == "__main__":

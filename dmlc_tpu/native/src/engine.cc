@@ -2225,6 +2225,9 @@ struct PipelineStats {
                                             // are preempted; see
                                             // thread_cpu_ns)
   std::atomic<int64_t> chunks{0};
+  std::atomic<int64_t> assemble_ns{0};      // padded-batch copy time on
+                                            // the consumer call (ABI 5;
+                                            // excludes queue waits)
   int64_t start_ns = now_ns();  // sane wall even before the first run
   std::atomic<int64_t> end_ns{0};           // set at end (incl. error)
 
@@ -2233,9 +2236,31 @@ struct PipelineStats {
     parse_busy_ns = 0;
     parse_cpu_ns = 0;
     chunks = 0;
+    assemble_ns = 0;
     start_ns = now_ns();
     end_ns = 0;
   }
+};
+
+// ---------------------------------------------- padded device blocks
+// ABI-5 native batch assembly: a PaddedBlock is one bucket-padded,
+// device-layout batch — the same field set, dtypes, neutral pad values
+// and offset rebasing as the Python fused path (pad_to_bucket /
+// stack_padded_rows in dmlc_tpu/data/padding.py, which stays the golden
+// and the fallback). Buffers are pooled Bufs, so steady-state emission
+// allocates nothing and arena bytes return to the free list the moment
+// a batch is cut (Python never holds the arena).
+struct PaddedBlock {
+  Buf<int64_t> offset;   // row_bucket + 1; pad rows repeat num_nnz
+  Buf<float> label;      // row_bucket; pad 0
+  Buf<float> weight;     // row_bucket; absent weights fill 1, pad 0
+  Buf<float> value;      // nnz_bucket; pad 0
+  Buf<uint32_t> index32; // nnz_bucket; pad 0 (narrow path)
+  Buf<uint64_t> index64; // nnz_bucket; pad 0 (wide path)
+  Buf<int64_t> qid;      // row_bucket; fill/pad -1 (only when has_qid)
+  Buf<int64_t> field;    // nnz_bucket; fill/pad 0 (only when has_field)
+  int64_t num_rows = 0, num_nnz = 0;
+  bool wide = false, has_qid = false, has_field = false;
 };
 
 struct ParserHandle {
@@ -2272,6 +2297,17 @@ struct ParserHandle {
   // blocks handed to the consumer stay valid until released (zero-copy
   // at the ABI; bindings release the previous block on the next next())
   std::map<CSRArena*, std::unique_ptr<CSRArena>> outstanding;
+
+  // ABI-5 padded emission state. carry = the arena currently being cut
+  // into padded batches (carry_row rows of it already copied out);
+  // recycled to arena_pool the moment its last row lands in a padded
+  // buffer — the consumer never holds an arena on the padded path.
+  std::unique_ptr<CSRArena> carry;
+  size_t carry_row = 0;
+  bool padded_eof = false;
+  std::vector<std::unique_ptr<PaddedBlock>> padded_pool;
+  std::map<PaddedBlock*, std::unique_ptr<PaddedBlock>> outstanding_padded;
+  int64_t last_pop_ns = 0;  // trace anchor: set after a successful pop
 
   std::unique_ptr<CSRArena> GetArena() {
     std::unique_ptr<CSRArena> a;
@@ -2421,17 +2457,21 @@ struct ParserHandle {
     }
   }
 
-  // returns rows; 0 = end; -1 = error (message in this->error)
-  int64_t Next() {
+  // Pull the next NON-EMPTY arena (indexing-mode fixups applied),
+  // transferring ownership to *out. Returns rows (>0), 0 at end of
+  // stream, -1 on error (message in this->error). Shared by Next()
+  // (lease-to-consumer path) and NextPadded() (device-layout assembly):
+  // the two paths parse identically and differ only in who owns the
+  // arena afterwards.
+  int64_t NextArena(std::unique_ptr<CSRArena>* out) {
     if (!blocks) StartPipeline();
     BlockItem item;
     while (blocks->Pop(&item)) {
-      // assemble span starts AFTER the pop: the blocking wait itself
-      // already rides on the Python timeline as the pull/<stage> span
-      int64_t a0 = trace_on() ? now_ns() : 0;
+      // trace anchor AFTER the pop: the blocking wait itself already
+      // rides on the Python timeline as the pull/<stage> span
+      last_pop_ns = trace_on() ? now_ns() : 0;
       if (!item.arena) {
         error = item.error;
-        last = nullptr;
         stats.end_ns = now_ns();  // error ends the run's wall clock too
         return -1;
       }
@@ -2464,18 +2504,9 @@ struct ParserHandle {
         RecycleArena(std::move(a));
         continue;
       }
-      CSRArena* raw = a.get();
-      {
-        std::lock_guard<std::mutex> lk(pool_mu);
-        outstanding[raw] = std::move(a);
-      }
-      last = raw;
-      if (a0)
-        ring.Record(kTraceBatchAssemble, kTidConsumer, a0, now_ns() - a0,
-                    (int64_t)raw->rows());
-      return (int64_t)raw->rows();
+      *out = std::move(a);
+      return (int64_t)(*out)->rows();
     }
-    last = nullptr;
     stats.end_ns = now_ns();
     max_chunk_depth = chunks ? chunks->max_depth() : 0;
     max_reorder_depth = blocks ? blocks->max_depth() : 0;
@@ -2485,6 +2516,257 @@ struct ParserHandle {
     // CSR blocks handed out (or leased) are arena copies, never views
     reader->ReleaseViews();
     return 0;
+  }
+
+  // returns rows; 0 = end; -1 = error (message in this->error)
+  int64_t Next() {
+    std::unique_ptr<CSRArena> a;
+    int64_t rows = NextArena(&a);
+    if (rows <= 0) {
+      last = nullptr;
+      return rows;
+    }
+    CSRArena* raw = a.get();
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      outstanding[raw] = std::move(a);
+    }
+    last = raw;
+    if (last_pop_ns)
+      ring.Record(kTraceBatchAssemble, kTidConsumer, last_pop_ns,
+                  now_ns() - last_pop_ns, (int64_t)raw->rows());
+    return rows;
+  }
+
+  // ---- ABI-5 padded emission (see PaddedBlock above) ----
+
+  std::unique_ptr<PaddedBlock> GetPadded() {
+    std::lock_guard<std::mutex> lk(pool_mu);
+    if (!padded_pool.empty()) {
+      auto b = std::move(padded_pool.back());
+      padded_pool.pop_back();
+      return b;
+    }
+    return std::make_unique<PaddedBlock>();
+  }
+
+  void ReleasePadded(PaddedBlock* b) {
+    std::lock_guard<std::mutex> lk(pool_mu);
+    auto it = outstanding_padded.find(b);
+    if (it == outstanding_padded.end()) return;
+    padded_pool.push_back(std::move(it->second));
+    outstanding_padded.erase(it);
+  }
+
+  size_t OutstandingCount() {
+    std::lock_guard<std::mutex> lk(pool_mu);
+    return outstanding.size() + outstanding_padded.size();
+  }
+
+  // Assemble ONE bucket-padded, device-layout batch of up to
+  // rows_per_batch rows (short only at end of stream). Matches the
+  // Python fused golden (data/padding.py stack_padded_rows over a
+  // RowBlockContainer batch) byte for byte: offset rebased per batch
+  // with the pad tail repeating num_nnz, label/weight pad 0 (absent
+  // weights fill 1), index/value/field pad 0, qid fill/pad -1; qid key
+  // emitted iff some row's qid != -1 (or want_qid), field key iff some
+  // constituent arena carried fields (or want_field). Returns rows
+  // (>0), 0 at end, -1 error.
+  int64_t NextPadded(int64_t rows_per_batch, int64_t row_bucket,
+                     int64_t nnz_bucket, bool want_qid, bool want_field,
+                     PaddedBlock** out) {
+    if (rows_per_batch < 1 || row_bucket < rows_per_batch ||
+        nnz_bucket < 0) {
+      error = "padded batch: need 1 <= rows_per_batch <= row_bucket";
+      return -1;
+    }
+    auto pb = GetPadded();
+    auto recycle_pb = [&] {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      padded_pool.push_back(std::move(pb));
+    };
+    // pooled buffers: clear n BEFORE reserve so a regrow never pays a
+    // copy of stale contents; n is then set to the bucket size and all
+    // writes go through raw data() cursors
+    auto prep = [](auto& buf, size_t count) {
+      buf.clear();
+      buf.reserve(count);
+      buf.n = count;
+    };
+    prep(pb->offset, (size_t)row_bucket + 1);
+    prep(pb->label, (size_t)row_bucket);
+    prep(pb->weight, (size_t)row_bucket);
+    prep(pb->value, (size_t)nnz_bucket);
+    prep(pb->index32, (size_t)nnz_bucket);
+    pb->index64.clear();
+    pb->qid.clear();
+    pb->field.clear();
+    pb->wide = false;
+    int64_t r = 0, z = 0;
+    bool any_qid = false, any_field = false;
+    bool qid_filled = false, field_filled = false;
+    int64_t t_first = 0, batch_ns = 0;
+    pb->offset.data()[0] = 0;
+    while (r < rows_per_batch) {
+      if (!carry) {
+        if (padded_eof) break;
+        int64_t rows = NextArena(&carry);
+        if (rows < 0) {
+          recycle_pb();
+          return -1;
+        }
+        if (rows == 0) {
+          padded_eof = true;
+          break;
+        }
+        carry_row = 0;
+      }
+      int64_t t0 = now_ns();
+      if (!t_first) t_first = t0;
+      CSRArena* a = carry.get();
+      size_t take = std::min((size_t)(rows_per_batch - r),
+                             a->rows() - carry_row);
+      int64_t a_lo = a->offset[carry_row];
+      int64_t slice_nnz = a->offset[carry_row + take] - a_lo;
+      if (z + slice_nnz > nnz_bucket) {
+        error = "padded batch: nnz " + std::to_string(z + slice_nnz) +
+                " exceeds nnz_bucket " + std::to_string(nnz_bucket) +
+                " (nnz bucket too small)";
+        recycle_pb();
+        return -1;
+      }
+      // offset: rebase the slice by a constant delta
+      {
+        int64_t delta = z - a_lo;
+        int64_t* po = pb->offset.data() + r + 1;
+        const int64_t* so = a->offset.data() + carry_row + 1;
+        for (size_t k = 0; k < take; ++k) po[k] = so[k] + delta;
+      }
+      std::memcpy(pb->label.data() + r, a->label.data() + carry_row,
+                  take * sizeof(float));
+      if (a->has_weight)
+        std::memcpy(pb->weight.data() + r, a->weight.data() + carry_row,
+                    take * sizeof(float));
+      else
+        std::fill(pb->weight.data() + r, pb->weight.data() + r + take,
+                  1.0f);
+      if (a->has_qid || qid_filled || want_qid) {
+        if (!qid_filled) {
+          prep(pb->qid, (size_t)row_bucket);
+          std::fill(pb->qid.data(), pb->qid.data() + r, (int64_t)-1);
+          qid_filled = true;
+        }
+        int64_t* pq = pb->qid.data() + r;
+        if (a->has_qid) {
+          const int64_t* sq = a->qid.data() + carry_row;
+          for (size_t k = 0; k < take; ++k) {
+            pq[k] = sq[k];
+            any_qid |= sq[k] != -1;
+          }
+        } else {
+          std::fill(pq, pq + take, (int64_t)-1);
+        }
+      }
+      if (a->has_field || field_filled || want_field) {
+        if (!field_filled) {
+          prep(pb->field, (size_t)nnz_bucket);
+          std::fill(pb->field.data(), pb->field.data() + z, (int64_t)0);
+          field_filled = true;
+        }
+        int64_t* pf = pb->field.data() + z;
+        if (a->has_field) {
+          std::memcpy(pf, a->field.data() + a_lo,
+                      (size_t)slice_nnz * sizeof(int64_t));
+          any_field = true;
+        } else {
+          std::fill(pf, pf + slice_nnz, (int64_t)0);
+        }
+      }
+      if (a->wide) {
+        if (!pb->wide) {
+          prep(pb->index64, (size_t)nnz_bucket);
+          const uint32_t* s32 = pb->index32.data();
+          uint64_t* d64 = pb->index64.data();
+          for (int64_t k = 0; k < z; ++k) d64[k] = s32[k];
+          pb->wide = true;
+        }
+        std::memcpy(pb->index64.data() + z, a->index64.data() + a_lo,
+                    (size_t)slice_nnz * sizeof(uint64_t));
+      } else if (pb->wide) {
+        const uint32_t* s32 = a->index32.data() + a_lo;
+        uint64_t* d64 = pb->index64.data() + z;
+        for (int64_t k = 0; k < slice_nnz; ++k) d64[k] = s32[k];
+      } else {
+        std::memcpy(pb->index32.data() + z, a->index32.data() + a_lo,
+                    (size_t)slice_nnz * sizeof(uint32_t));
+      }
+      std::memcpy(pb->value.data() + z, a->value.data() + a_lo,
+                  (size_t)slice_nnz * sizeof(float));
+      r += (int64_t)take;
+      z += slice_nnz;
+      carry_row += take;
+      if (carry_row == a->rows()) {
+        // the whole arena is in padded buffers: its bytes return to
+        // the free list NOW, not when the consumer finishes the batch
+        RecycleArena(std::move(carry));
+        carry_row = 0;
+      }
+      batch_ns += now_ns() - t0;
+    }
+    if (r == 0) {
+      recycle_pb();
+      return 0;  // clean end of stream
+    }
+    int64_t t0 = now_ns();
+    if (!t_first) t_first = t0;
+    // neutral pad tails — the exact values the Python fused path writes
+    std::fill(pb->offset.data() + r + 1,
+              pb->offset.data() + row_bucket + 1, z);
+    std::fill(pb->label.data() + r, pb->label.data() + row_bucket, 0.0f);
+    std::fill(pb->weight.data() + r, pb->weight.data() + row_bucket,
+              0.0f);
+    pb->has_qid = want_qid || any_qid;
+    if (pb->has_qid) {
+      if (!qid_filled) {
+        prep(pb->qid, (size_t)row_bucket);
+        std::fill(pb->qid.data(), pb->qid.data() + r, (int64_t)-1);
+      }
+      std::fill(pb->qid.data() + r, pb->qid.data() + row_bucket,
+                (int64_t)-1);
+    }
+    pb->has_field = want_field || any_field;
+    if (pb->has_field) {
+      if (!field_filled) {
+        prep(pb->field, (size_t)nnz_bucket);
+        std::fill(pb->field.data(), pb->field.data() + z, (int64_t)0);
+      }
+      std::fill(pb->field.data() + z, pb->field.data() + nnz_bucket,
+                (int64_t)0);
+    }
+    if (pb->wide)
+      std::fill(pb->index64.data() + z, pb->index64.data() + nnz_bucket,
+                (uint64_t)0);
+    else
+      std::fill(pb->index32.data() + z, pb->index32.data() + nnz_bucket,
+                (uint32_t)0);
+    std::fill(pb->value.data() + z, pb->value.data() + nnz_bucket, 0.0f);
+    pb->num_rows = r;
+    pb->num_nnz = z;
+    batch_ns += now_ns() - t0;
+    stats.assemble_ns += batch_ns;
+    if (trace_on())
+      // one assemble span per padded batch, anchored at its first copy;
+      // duration is copy time only (queue waits between slices already
+      // ride on the Python pull span)
+      ring.Record(kTraceBatchAssemble, kTidConsumer, t_first, batch_ns,
+                  r);
+    PaddedBlock* raw = pb.get();
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      outstanding_padded[raw] = std::move(pb);
+    }
+    *out = raw;
+    return r;
   }
 
   // End-of-stream pool trim. The per-parser free lists exist to recycle
@@ -2499,10 +2781,12 @@ struct ParserHandle {
   // steady-state RSS tracks data actually retained, not pool slack.
   void TrimPools() {
     std::vector<std::unique_ptr<CSRArena>> drop_arenas;
+    std::vector<std::unique_ptr<PaddedBlock>> drop_padded;
     std::vector<std::string> drop_chunks;
     {
       std::lock_guard<std::mutex> lk(pool_mu);
       drop_arenas.swap(arena_pool);
+      drop_padded.swap(padded_pool);
       drop_chunks.swap(chunk_pool);
     }
     // destructors run outside pool_mu: BlockCache::Put takes its own
@@ -2843,9 +3127,12 @@ const char* dtp_last_error() { return g_last_error.c_str(); }
 // ABI history: 1 = initial; 2 = lease-based dtp_parser_next outparams;
 // 3 = dtp_parser_create grew the 13th `sparse` argument (CSV zero-drop);
 // 4 = span-ring trace surface (dtp_trace_set_enabled/dtp_trace_enabled/
-//     dtp_now_ns/dtp_parser_trace_drain).
+//     dtp_now_ns/dtp_parser_trace_drain);
+// 5 = native batch assembly (dtp_parser_next_padded/dtp_padded_release/
+//     dtp_parser_start/dtp_parser_outstanding; dtp_parser_stats out
+//     grew to 8 slots — out[7] = assemble_ns).
 // Bump on ANY signature change — bindings.load() refuses mismatches.
-int dtp_version() { return 4; }
+int dtp_version() { return 5; }
 
 // ------------------------------------------------------------- tracing
 
@@ -2949,12 +3236,94 @@ int64_t dtp_parser_next(void* handle, void** block_out,
   return rows;
 }
 
+// ABI-5 native batch assembly: pull ONE bucket-padded, device-layout
+// batch of up to rows_per_batch rows (short only at end of stream).
+// Returns num_rows (>0), 0 at end, -1 on error (dtp_last_error).
+// *block_out receives an opaque padded-block lease; every returned
+// pointer is a zero-copy view into it, valid until
+// dtp_padded_release(handle, block) or destroy. Array layout (the
+// Python fused golden's, data/padding.py): offset[row_bucket+1] with
+// the pad tail repeating *num_nnz, label/weight[row_bucket] (pad 0;
+// absent weights fill 1), index/value[nnz_bucket] (pad 0; *wide picks
+// index32 vs index64), qid[row_bucket] (fill/pad -1, present iff
+// *has_qid), field[nnz_bucket] (fill/pad 0, present iff *has_field).
+// Source arenas are recycled the moment their rows are copied — the
+// consumer never holds arena bytes on this path. Do not interleave
+// with dtp_parser_next inside one epoch (rows already cut into the
+// padded carry would be skipped); dtp_parser_before_first resets.
+int64_t dtp_parser_next_padded(
+    void* handle, int64_t rows_per_batch, int64_t row_bucket,
+    int64_t nnz_bucket, int want_qid, int want_field, void** block_out,
+    const int64_t** offset, const float** label, const float** weight,
+    const float** value, const uint32_t** index32,
+    const uint64_t** index64, const int64_t** qid, const int64_t** field,
+    int64_t* num_nnz, int* wide, int* has_qid, int* has_field) {
+  auto* h = static_cast<ParserHandle*>(handle);
+  PaddedBlock* b = nullptr;
+  int64_t rows = h->NextPadded(rows_per_batch, row_bucket, nnz_bucket,
+                               want_qid != 0, want_field != 0, &b);
+  if (rows < 0) {
+    g_last_error = h->error;
+    return -1;
+  }
+  if (rows == 0) return 0;
+  *block_out = b;
+  *offset = b->offset.data();
+  *label = b->label.data();
+  *weight = b->weight.data();
+  *value = b->value.data();
+  if (b->wide) {
+    *index32 = nullptr;
+    *index64 = b->index64.data();
+  } else {
+    *index32 = b->index32.data();
+    *index64 = nullptr;
+  }
+  *qid = b->has_qid ? b->qid.data() : nullptr;
+  *field = b->has_field ? b->field.data() : nullptr;
+  *num_nnz = b->num_nnz;
+  *wide = b->wide ? 1 : 0;
+  *has_qid = b->has_qid ? 1 : 0;
+  *has_field = b->has_field ? 1 : 0;
+  return rows;
+}
+
+// Return a padded block's buffers to the handle's pool (steady-state
+// padded emission then allocates nothing).
+void dtp_padded_release(void* handle, void* block) {
+  if (!handle || !block) return;
+  static_cast<ParserHandle*>(handle)->ReleasePadded(
+      static_cast<PaddedBlock*>(block));
+}
+
+// Kick the parse pipeline without consuming a block: reader + worker
+// threads start immediately. Lets N sharded sub-parsers over byte
+// ranges of one file all run ahead while the consumer drains them in
+// order (bindings.NativeShardedTextParser). No-op while running.
+void dtp_parser_start(void* handle) {
+  auto* h = static_cast<ParserHandle*>(handle);
+  if (!h->blocks) h->StartPipeline();
+}
+
+// Outstanding leases (CSR arenas + padded blocks) held by consumers —
+// the leak probe: after padded emission the source arenas must be back
+// in the free list even while the padded leases are still held.
+int64_t dtp_parser_outstanding(void* handle) {
+  return (int64_t)static_cast<ParserHandle*>(handle)->OutstandingCount();
+}
+
 void dtp_parser_before_first(void* handle) {
   auto* h = static_cast<ParserHandle*>(handle);
   h->StopPipeline();
   h->ncol.store(-1);
   h->mode_resolved = false;
   h->last = nullptr;
+  // padded-emission carry state resets with the epoch (the partially
+  // consumed arena goes back to the pool; leased padded blocks stay
+  // valid until released, same contract as CSR leases)
+  if (h->carry) h->RecycleArena(std::move(h->carry));
+  h->carry_row = 0;
+  h->padded_eof = false;
   // outstanding blocks stay valid across epochs until released;
   // pipeline restarts lazily on next()
 }
@@ -3001,10 +3370,11 @@ void dtp_block_release(void* handle, void* block) {
       static_cast<CSRArena*>(block));
 }
 
-// Stage timings + pipeline shape of the current/last run. out[7]:
+// Stage timings + pipeline shape of the current/last run. out[8]:
 // [reader_busy_ns, parse_busy_ns (wall, summed over workers), wall_ns,
 //  chunks, max_chunk_queue_depth, max_reorder_depth,
-//  parse_cpu_ns (thread CPU, summed — the honest per-core kernel rate)]
+//  parse_cpu_ns (thread CPU, summed — the honest per-core kernel rate),
+//  assemble_ns (ABI 5: padded-batch copy time on the consumer call)]
 // reader_busy + parse_busy > wall proves IO/parse (or parse/parse)
 // overlap; parse_busy/wall ~ N proves N-way parse scaling.
 void dtp_parser_stats(void* handle, int64_t* out) {
@@ -3019,6 +3389,7 @@ void dtp_parser_stats(void* handle, int64_t* out) {
   out[5] = (int64_t)(h->blocks ? h->blocks->max_depth()
                                : h->max_reorder_depth);
   out[6] = h->stats.parse_cpu_ns.load();
+  out[7] = h->stats.assemble_ns.load();
 }
 
 // Test hook: FNV-checksum every chunk byte `rounds` times per chunk

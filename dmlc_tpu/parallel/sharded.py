@@ -30,9 +30,15 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_tpu.data.padding import (  # noqa: F401 — re-exported: the
+    # padding/layout contract moved to data/padding.py (one home shared
+    # with the native engine's ABI-5 padded blocks); existing importers
+    # keep finding the names here
+    ensure_schema, pad_to_bucket, stack_padded_rows,
+)
 from dmlc_tpu.data.rowblock import RowBlock
 from dmlc_tpu.utils.logging import (
-    DMLCError, check, check_eq, check_le,
+    DMLCError, check, check_eq,
 )
 
 __all__ = ["pad_to_bucket", "stack_device_batches", "make_global_batch",
@@ -55,62 +61,6 @@ def empty_block(index_dtype=np.uint32) -> RowBlock:
                     index=np.zeros(0, index_dtype))
 
 
-def pad_to_bucket(block: RowBlock, row_bucket: int,
-                  nnz_bucket: int) -> Dict[str, np.ndarray]:
-    """CSR RowBlock → fixed-shape numpy dict (padded, compute-neutral).
-
-    Keys: offset[row_bucket+1] int64, label/weight[row_bucket] f32,
-    index[nnz_bucket] (block dtype), value[nnz_bucket] f32,
-    num_rows/num_nnz scalars int32. Padded rows are empty (offset
-    repeats) with weight 0; padded nnz carry index 0, value 0.
-    """
-    n, nnz = block.size, block.nnz
-    check_le(n, row_bucket, "row bucket too small")
-    check_le(nnz, nnz_bucket, "nnz bucket too small")
-    offset = np.full(row_bucket + 1, nnz, np.int64)
-    offset[:n + 1] = block.offset
-    label = np.zeros(row_bucket, np.float32)
-    label[:n] = block.label
-    weight = np.zeros(row_bucket, np.float32)
-    weight[:n] = block.weight if block.weight is not None else 1.0
-    index = np.zeros(nnz_bucket, block.index.dtype)
-    index[:nnz] = block.index
-    value = np.zeros(nnz_bucket, np.float32)
-    if block.value is not None:
-        value[:nnz] = block.value
-    else:
-        value[:nnz] = 1.0
-    out = {"offset": offset, "label": label, "weight": weight,
-           "index": index, "value": value,
-           "num_rows": np.int32(n), "num_nnz": np.int32(nnz)}
-    if block.qid is not None:
-        qid = np.full(row_bucket, -1, np.int64)
-        qid[:n] = block.qid
-        out["qid"] = qid
-    if block.field is not None:
-        field = np.zeros(nnz_bucket, np.int64)
-        field[:nnz] = block.field
-        out["field"] = field
-    return out
-
-
-def ensure_schema(padded: Dict[str, np.ndarray], row_bucket: int,
-                  nnz_bucket: int, want_qid: bool,
-                  want_field: bool) -> Dict[str, np.ndarray]:
-    """Force the optional qid/field keys onto a padded dict that lacks
-    them (qid pads -1, field pads 0 — the same neutral values
-    pad_to_bucket uses under real data). Every dict in a stacked round
-    must carry ONE key set; without this, a part that exhausts before
-    the global round count pads with key-less empty blocks and
-    stack_device_batches raises on qid/field-bearing sources (ADVICE
-    r4)."""
-    if want_qid and "qid" not in padded:
-        padded["qid"] = np.full(row_bucket, -1, np.int64)
-    if want_field and "field" not in padded:
-        padded["field"] = np.zeros(nnz_bucket, np.int64)
-    return padded
-
-
 def stack_device_batches(batches: List[Dict[str, np.ndarray]]
                          ) -> Dict[str, np.ndarray]:
     """Per-device padded dicts → one local dict with leading device dim."""
@@ -119,60 +69,6 @@ def stack_device_batches(batches: List[Dict[str, np.ndarray]]
     for b in batches[1:]:
         check_eq(set(b.keys()), set(keys), "inconsistent batch keys")
     return {k: np.stack([np.asarray(b[k]) for b in batches]) for k in keys}
-
-
-def stack_padded_rows(blocks: List[RowBlock], row_bucket: int,
-                      nnz_bucket: int, want_qid: bool = False,
-                      want_field: bool = False) -> Dict[str, np.ndarray]:
-    """pad_to_bucket + ensure_schema + stack_device_batches fused into
-    ONE pass: the stacked [L, ...] arrays are allocated directly and
-    each device's slice written in place — no per-device intermediate
-    arrays, no np.stack copy. Byte-identical to the composed path
-    (pinned by test_fused_stack_matches_composed_path); this is the
-    serve-thread hot loop of steady replay, where every written byte is
-    throughput off the page tier, so it writes each element once
-    (data prefix + neutral-pad tail) instead of fill-then-overwrite."""
-    L = len(blocks)
-    check(L > 0, "no device batches")
-    has_qid = want_qid or any(b.qid is not None for b in blocks)
-    has_field = want_field or any(b.field is not None for b in blocks)
-    rb, nb = row_bucket, nnz_bucket
-    out = {
-        "offset": np.empty((L, rb + 1), np.int64),
-        "label": np.empty((L, rb), np.float32),
-        "weight": np.empty((L, rb), np.float32),
-        "index": np.empty((L, nb), blocks[0].index.dtype),
-        "value": np.empty((L, nb), np.float32),
-        "num_rows": np.empty(L, np.int32),
-        "num_nnz": np.empty(L, np.int32),
-    }
-    if has_qid:
-        out["qid"] = np.empty((L, rb), np.int64)
-    if has_field:
-        out["field"] = np.empty((L, nb), np.int64)
-    for i, b in enumerate(blocks):
-        n, nnz = b.size, b.nnz
-        check_le(n, rb, "row bucket too small")
-        check_le(nnz, nb, "nnz bucket too small")
-        out["offset"][i, :n + 1] = b.offset
-        out["offset"][i, n + 1:] = nnz
-        out["label"][i, :n] = b.label
-        out["label"][i, n:] = 0.0
-        out["weight"][i, :n] = b.weight if b.weight is not None else 1.0
-        out["weight"][i, n:] = 0.0
-        out["index"][i, :nnz] = b.index
-        out["index"][i, nnz:] = 0
-        out["value"][i, :nnz] = b.value if b.value is not None else 1.0
-        out["value"][i, nnz:] = 0.0
-        out["num_rows"][i] = n
-        out["num_nnz"][i] = nnz
-        if has_qid:
-            out["qid"][i, :n] = b.qid if b.qid is not None else -1
-            out["qid"][i, n:] = -1
-        if has_field:
-            out["field"][i, :nnz] = b.field if b.field is not None else 0
-            out["field"][i, nnz:] = 0
-    return out
 
 
 def make_global_batch(local: Dict[str, np.ndarray], mesh: Mesh,

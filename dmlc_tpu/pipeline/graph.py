@@ -139,6 +139,37 @@ class _RunnerBase:
         pass
 
 
+def _finalize_parser(parser, probe) -> None:
+    """Stamp a parser's end-of-epoch telemetry into ``probe``: engine
+    stats (+ counter track), the native span-ring drain onto the active
+    timeline, and bytes_read. Shared by _ParseRunner and the fused
+    _NativeAssembleRunner so both stages report the engine the same
+    way."""
+    stats_fn = getattr(parser, "stats", None)
+    if stats_fn is not None:
+        try:
+            engine = stats_fn()
+            probe.extra["engine"] = engine
+            # native-engine counters as a trace counter track: the
+            # reader/parse busy split rides next to the spans
+            _trace.counter("engine", engine, "native")
+        except Exception:  # noqa: BLE001 — telemetry must not kill
+            pass
+    rec = _trace.active()
+    drain = getattr(parser, "drain_trace", None)
+    if rec is not None and drain is not None:
+        # the engine's span ring (chunk read/tokenize/assemble/
+        # cache events) joins the Python spans on ONE timeline
+        try:
+            drain(rec)
+        except Exception:  # noqa: BLE001 — telemetry must not kill
+            pass
+    try:
+        probe.extra["bytes_read"] = int(parser.bytes_read())
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class _ParseRunner(_RunnerBase):
     """source [+ shuffle] + parse → Parser.create (native or python)."""
 
@@ -217,29 +248,7 @@ class _ParseRunner(_RunnerBase):
         return []
 
     def finalize_epoch(self) -> None:
-        stats_fn = getattr(self._parser, "stats", None)
-        if stats_fn is not None:
-            try:
-                engine = stats_fn()
-                self.probe.extra["engine"] = engine
-                # native-engine counters as a trace counter track: the
-                # reader/parse busy split rides next to the spans
-                _trace.counter("engine", engine, "native")
-            except Exception:  # noqa: BLE001 — telemetry must not kill
-                pass
-        rec = _trace.active()
-        drain = getattr(self._parser, "drain_trace", None)
-        if rec is not None and drain is not None:
-            # the engine's span ring (chunk read/tokenize/assemble/
-            # cache events) joins the Python spans on ONE timeline
-            try:
-                drain(rec)
-            except Exception:  # noqa: BLE001 — telemetry must not kill
-                pass
-        try:
-            self.probe.extra["bytes_read"] = int(self._parser.bytes_read())
-        except Exception:  # noqa: BLE001
-            pass
+        _finalize_parser(self._parser, self.probe)
 
     def close(self) -> None:
         if hasattr(self._parser, "destroy"):
@@ -493,6 +502,128 @@ class _BatchRunner(_RunnerBase):
             yield pending.get_block()
 
 
+class _PadBatchRunner(_RunnerBase):
+    """Padded batch assembly, the Python fused golden: re-chunk the
+    block stream to ``rows`` rows, then pad each batch to
+    (row_bucket, nnz_bucket) device layout in ONE pass
+    (data.padding.pad_single). Output dicts own their arrays. This is
+    the fallback — and the byte-parity reference — for the native
+    ABI-5 path (_NativeAssembleRunner); tests pin the two equal."""
+
+    kind = "assemble"
+
+    def __init__(self, up: _RunnerBase, spec: StageSpec):
+        super().__init__("assemble")
+        p = spec.params
+        self.up = up
+        self._rows = p["rows"]
+        self._drop = p["drop_remainder"]
+        self._row_bucket = p["row_bucket"] or p["rows"]
+        self._nnz_bucket = p["nnz_bucket"]
+        self._want_qid = p["want_qid"]
+        self._want_field = p["want_field"]
+        check(self._rows >= 1, "batch(rows) needs rows >= 1")
+        check(self._row_bucket >= self._rows,
+              "batch(row_bucket) must be >= rows")
+        self._assemble_s = 0.0
+
+    def epoch(self) -> Iterator:
+        from dmlc_tpu.data.padding import pad_single
+        from dmlc_tpu.data.rowblock import RowBlockContainer
+        self._assemble_s = 0.0
+
+        def cut(pending):
+            t0 = time.perf_counter()
+            padded = pad_single(pending.get_block(), self._row_bucket,
+                                self._nnz_bucket, self._want_qid,
+                                self._want_field)
+            self._assemble_s += time.perf_counter() - t0
+            return padded
+
+        pending = None
+        for block in _probed(self.up):
+            if pending is None:
+                pending = RowBlockContainer(block.index.dtype)
+            start = 0
+            while start < block.size:
+                take = min(block.size - start, self._rows - pending.size)
+                pending.push_block(block.slice(start, start + take))
+                start += take
+                if pending.size >= self._rows:
+                    yield cut(pending)
+                    pending = RowBlockContainer(block.index.dtype)
+        if pending is not None and pending.size and not self._drop:
+            yield cut(pending)
+
+    def finalize_epoch(self) -> None:
+        # which rung assembled the epoch's batches — bench attributes
+        # wins to native-padded vs python-fused with this field
+        self.probe.extra["assembly_path"] = "python-fused"
+        self.probe.extra["assemble_s"] = round(self._assemble_s, 6)
+
+
+class _NativeAssembleRunner(_RunnerBase):
+    """source + parse + batch(pad=True) fused onto the native engine's
+    ABI-5 batch assembly: ``dtp_parser_next_padded`` emits bucket-
+    padded, device-layout blocks directly from the parse arena — the
+    pad+stack memcpy runs in C with the GIL released and Python never
+    touches row bytes on this path. Each yielded PaddedBatch is a dict
+    of ZERO-COPY views into a leased padded block (valid until the next
+    pull — the standard RowBlock lifetime contract; downstream
+    prefetch/to_device detach the lease exactly as they do for CSR
+    leases). Byte parity with _PadBatchRunner is pinned by
+    tests/test_native_assembly.py."""
+
+    kind = "assemble"
+    owned = False  # items are leased engine views
+
+    def __init__(self, parse_runner: "_ParseRunner", spec: StageSpec):
+        super().__init__("assemble")
+        # take over the already-constructed parser (and its close/stats
+        # surface); the parse stage folds into this one
+        self._parser = parse_runner._parser
+        p = spec.params
+        self._rows = p["rows"]
+        self._drop = p["drop_remainder"]
+        self._row_bucket = p["row_bucket"] or p["rows"]
+        self._nnz_bucket = p["nnz_bucket"]
+        self._want_qid = p["want_qid"]
+        self._want_field = p["want_field"]
+        check(self._row_bucket >= self._rows,
+              "batch(row_bucket) must be >= rows")
+
+    def epoch(self) -> Iterator:
+        p = self._parser
+        p.before_first()
+        while True:
+            batch = p.next_padded(self._rows, self._row_bucket,
+                                  self._nnz_bucket, self._want_qid,
+                                  self._want_field)
+            if batch is None:
+                return
+            if self._drop and int(batch["num_rows"]) < self._rows:
+                continue  # short tail at end of stream
+            yield batch
+
+    def detach_last(self):
+        return self._parser.detach()
+
+    def finalize_epoch(self) -> None:
+        _finalize_parser(self._parser, self.probe)
+        self.probe.extra["assembly_path"] = "native-padded"
+        eng = self.probe.extra.get("engine") or {}
+        if eng.get("assemble_ns") is not None:
+            # consumer-side pad+stack memcpy time, measured in the
+            # engine (queue waits excluded) — comparable to the python
+            # path's assemble_s
+            self.probe.extra["assemble_s"] = round(
+                eng["assemble_ns"] / 1e9, 6)
+
+    def close(self) -> None:
+        if hasattr(self._parser, "destroy"):
+            self._parser.destroy()
+
+
 class _MapRunner(_RunnerBase):
     """User fn over each item. The fn sees the upstream item under the
     upstream's lifetime contract; ownership passes through unchanged."""
@@ -607,7 +738,8 @@ class _DeviceRunner(_RunnerBase):
 
     kind = "to_device"
 
-    def __init__(self, up: _RunnerBase, device, sharding, window):
+    def __init__(self, up: _RunnerBase, device, sharding, window,
+                 staging="auto"):
         super().__init__("to_device")
         self.up = up
         self._auto = window == "auto"
@@ -616,6 +748,15 @@ class _DeviceRunner(_RunnerBase):
         check(device is None or sharding is None,
               "to_device: pass device OR sharding, not both")
         self._target = sharding if sharding is not None else device
+        # staging: route batches through a reusable host staging pair
+        # (parallel.device_iter.HostStaging) so the source buffers are
+        # free at COPY time and the H2D transfer of batch N overlaps
+        # batch N+1's assembly. "auto" = on for dict batches (the
+        # fixed-shape padded steady path, where slot reuse pays), off
+        # for RowBlock streams (variable shapes defeat the pool).
+        check(staging in (True, False, "auto"),
+              "to_device(staging) must be True, False or 'auto'")
+        self._staging = staging
 
     @staticmethod
     def _host_arrays(item) -> Dict[str, np.ndarray]:
@@ -643,27 +784,44 @@ class _DeviceRunner(_RunnerBase):
 
     def epoch(self) -> Iterator:
         import jax
+
+        from dmlc_tpu.parallel.device_iter import HostStaging
         target = self._target
         put = (jax.device_put if target is None
                else (lambda x: jax.device_put(x, target)))
         cpu_backend = self._platform() == "cpu"
+        # staging pool built lazily at the first dict item under "auto":
+        # one pool per epoch, window+1 slots (window in flight + one
+        # being staged), no reuse on the aliasing CPU backend
+        pool: Optional[HostStaging] = None
+        if self._staging is True:
+            pool = HostStaging(self.window + 1, alias_unsafe=cpu_backend)
         in_flight: deque = deque()
         xfer_wait = 0.0
 
         def drain_one():
             nonlocal xfer_wait
-            fut, lease = in_flight.popleft()
+            fut, lease, slot, t_enq = in_flight.popleft()
             t0 = time.perf_counter()
             jax.block_until_ready(fut)
-            dt = time.perf_counter() - t0
+            now = time.perf_counter()
+            dt = now - t0
             xfer_wait += dt
             self.probe.extra["xfer_wait_s"] = round(xfer_wait, 6)
             rec = _trace.active()
             if rec is not None:
                 rec.complete("to_device.drain", t0, dt, "transfer",
                              {"in_flight": len(in_flight) + 1})
+                if slot is not None:
+                    # the full async window, enqueue → ready: it
+                    # overlaps the NEXT batch's device.assemble span —
+                    # the Perfetto-visible proof the double-buffer works
+                    rec.complete("device.xfer", t_enq, now - t_enq,
+                                 "transfer")
             if lease is not None:
                 lease.release()
+            if slot is not None:
+                pool.release(slot)
             return fut
 
         for item in _probed(self.up):
@@ -678,7 +836,23 @@ class _DeviceRunner(_RunnerBase):
             else:
                 lease = self.up.detach_last()
             arrs = self._host_arrays(item)
-            if lease is not None and cpu_backend:
+            if pool is None and self._staging == "auto" \
+                    and isinstance(item, dict):
+                pool = HostStaging(self.window + 1,
+                                   alias_unsafe=cpu_backend)
+            slot = None
+            if pool is not None:
+                # staged path: one copy into the reusable slot frees
+                # the source NOW — a leased padded block returns to the
+                # engine pool while its bytes are still in flight
+                slot = pool.stage(arrs)
+                arrs = slot
+                if lease is not None:
+                    lease.release()
+                    lease = None
+                self.probe.extra["staging_assemble_s"] = round(
+                    pool.assemble_s, 6)
+            elif lease is not None and cpu_backend:
                 # the CPU-aliasing rule (io/tpu_fs._device_put_safe):
                 # CPU-backend device_put may ALIAS host memory, and a
                 # leased arena gets recycled after release — copy now
@@ -688,7 +862,7 @@ class _DeviceRunner(_RunnerBase):
                 lease.release()
                 lease = None
             fut = put(arrs)
-            in_flight.append((fut, lease))
+            in_flight.append((fut, lease, slot, time.perf_counter()))
             # window is re-read each round: the autotuner adjusts it
             # between epochs (and a mid-epoch change is simply honored)
             while len(in_flight) > self.window:
@@ -874,11 +1048,38 @@ class Pipeline:
                                     memory_budget_bytes=memory_budget_bytes,
                                     page_budget_bytes=page_budget_bytes))
 
-    def batch(self, rows: int, drop_remainder: bool = False) -> "Pipeline":
+    def batch(self, rows: int, drop_remainder: bool = False,
+              pad: bool = False, row_bucket: Optional[int] = None,
+              nnz_bucket: Optional[int] = None, want_qid: bool = False,
+              want_field: bool = False) -> "Pipeline":
         """Re-chunk the block stream to exactly ``rows`` rows per block
-        (last partial block kept unless drop_remainder)."""
+        (last partial block kept unless drop_remainder).
+
+        ``pad=True`` (or passing ``nnz_bucket``) switches the stage to
+        PADDED batch assembly: each batch is a fixed-shape,
+        device-layout dict padded to (row_bucket, nnz_bucket) — the
+        data.padding layout contract (offset/label/weight/index/value
+        + num_rows/num_nnz, optional qid/field). ``row_bucket``
+        defaults to ``rows``; ``nnz_bucket`` is required (it bounds the
+        batch's nnz — a batch that exceeds it raises). When the stage
+        sits directly on a native-engine parse, assembly lowers onto
+        the engine's ABI-5 ``dtp_parser_next_padded`` (zero-copy leased
+        views, Python never touches row bytes); otherwise the Python
+        fused golden pads — byte-identical, pinned. The lowering that
+        ran is reported as ``assembly_path`` in the stage stats."""
+        pad = pad or nnz_bucket is not None
+        if pad:
+            check(nnz_bucket is not None,
+                  "batch(pad=True) needs nnz_bucket (the padded batch's "
+                  "fixed nnz capacity)")
+            check(row_bucket is None or row_bucket >= rows,
+                  "batch(row_bucket) must be >= rows")
         return self._with(StageSpec("batch", rows=rows,
-                                    drop_remainder=drop_remainder))
+                                    drop_remainder=drop_remainder,
+                                    pad=pad, row_bucket=row_bucket,
+                                    nnz_bucket=nnz_bucket,
+                                    want_qid=want_qid,
+                                    want_field=want_field))
 
     def map(self, fn: Callable, name: Optional[str] = None) -> "Pipeline":
         """Apply ``fn`` to every item. ``fn`` sees items under the
@@ -901,11 +1102,17 @@ class Pipeline:
                                     nnz_bucket=nnz_bucket, **kwargs))
 
     def to_device(self, device=None, sharding=None,
-                  window="auto") -> "Pipeline":
+                  window="auto", staging="auto") -> "Pipeline":
         """Async host→device transfers, ``window`` in flight;
-        window="auto" is an autotuner knob."""
+        window="auto" is an autotuner knob. ``staging`` routes batches
+        through a reusable host staging pair (copy frees the source
+        immediately; transfer N overlaps assembly N+1, proven by
+        device.assemble/device.xfer spans and the device.staging
+        gauge): True, False, or "auto" (on for dict batches — the
+        fixed-shape padded steady path — off for RowBlock streams)."""
         return self._with(StageSpec("to_device", device=device,
-                                    sharding=sharding, window=window))
+                                    sharding=sharding, window=window,
+                                    staging=staging))
 
     # -- compilation
 
@@ -943,7 +1150,18 @@ class Pipeline:
             runners.append(_ParseRunner(source, shuffle_spec, parse_spec))
         for spec in specs[i:]:
             up = runners[-1]
-            if spec.kind == "batch":
+            if spec.kind == "batch" and spec.params.get("pad"):
+                # padded assembly sitting DIRECTLY on a native-engine
+                # parse fuses into the engine's ABI-5 batch assembly;
+                # anything else (python engine, cache/shuffle upstream,
+                # sharded parser, map between) pads through the Python
+                # fused golden — byte-identical by the pinned contract
+                if (len(runners) == 1 and isinstance(up, _ParseRunner)
+                        and hasattr(up._parser, "next_padded")):
+                    runners[-1] = _NativeAssembleRunner(up, spec)
+                else:
+                    runners.append(_PadBatchRunner(up, spec))
+            elif spec.kind == "batch":
                 runners.append(_BatchRunner(up, spec.params["rows"],
                                             spec.params["drop_remainder"]))
             elif spec.kind == "map":
@@ -952,9 +1170,10 @@ class Pipeline:
             elif spec.kind == "prefetch":
                 runners.append(_PrefetchRunner(up, spec.params["depth"]))
             elif spec.kind == "to_device":
-                runners.append(_DeviceRunner(up, spec.params["device"],
-                                             spec.params["sharding"],
-                                             spec.params["window"]))
+                runners.append(_DeviceRunner(
+                    up, spec.params["device"], spec.params["sharding"],
+                    spec.params["window"],
+                    spec.params.get("staging", "auto")))
             else:  # pragma: no cover — validate_chain rejects these
                 raise DMLCError(f"pipeline: unexpected stage {spec.kind!r}")
         tuner = None

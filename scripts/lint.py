@@ -28,6 +28,12 @@ without the tools baked in:
   not ad-hoc control flow. The two pre-resilience skip-not-retry
   handlers are pinned in an allowlist; the list shrinks, it does not
   grow.
+- **Steady-path gate** (always run, AST-based): inside
+  ``dmlc_tpu/data/`` and ``dmlc_tpu/pipeline/``, per-row Python loops
+  over block payloads (``for row in …`` or ``range(<x>.size)`` index
+  loops) are forbidden outside the pinned golden-path allowlist — the
+  per-row work belongs to the engine's ABI-5 padded emission
+  (``dtp_parser_next_padded``) or the vectorized ``data.padding`` ops.
 - **ruff** over the Python tree and **clang-format --dry-run -Werror**
   over native/src/ — run when the binaries are importable/installed,
   reported as skipped otherwise.
@@ -374,6 +380,69 @@ def resilience_lint(paths: List[str],
     return findings
 
 
+# The steady path never iterates row payloads in Python (ISSUE 7: the
+# engine's ABI-5 padded emission and the vectorized data.padding ops
+# own the per-row work — PR 2 measured ~2× for eliminating one Python
+# memcpy layer, and a `for row in block` loop is strictly worse).
+# Inside dmlc_tpu/data/ and dmlc_tpu/pipeline/, a loop whose target is
+# literally `row` or whose iterable is `range(<x>.size)`/
+# `range(<x>.num_rows)` is per-row Python on the hot path. The golden
+# Row protocol itself (RowBlock.__iter__/__getitem__ in
+# data/rowblock.py — the DEBUGGING surface, not a steady-path stage)
+# is pinned. The list shrinks, it does not grow.
+ROW_LOOP_ALLOWED = {
+    "dmlc_tpu/data/rowblock.py",
+}
+_ROW_LOOP_DIRS = ("dmlc_tpu/data/", "dmlc_tpu/pipeline/")
+
+
+def _target_names(t: ast.AST) -> List[str]:
+    return [n.id for n in ast.walk(t) if isinstance(n, ast.Name)]
+
+
+def _iter_is_per_row(it: ast.AST) -> bool:
+    """range(X.size) / range(X.num_rows): a per-row index loop."""
+    if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id == "range"):
+        for arg in it.args:
+            for n in ast.walk(arg):
+                if (isinstance(n, ast.Attribute)
+                        and n.attr in ("size", "num_rows")):
+                    return True
+    return False
+
+
+def row_loop_lint(paths: List[str],
+                  trees: Optional[dict] = None) -> List[str]:
+    """The steady-path gate: no per-row Python loops over block
+    payloads in dmlc_tpu/data/ or dmlc_tpu/pipeline/ (see above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    msg = ("per-row Python loop on the steady path — rows are engine "
+           "work (dtp_parser_next_padded) or vectorized numpy "
+           "(data.padding); stages operate on whole blocks")
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if (not rel.startswith(_ROW_LOOP_DIRS)
+                or rel in ROW_LOOP_ALLOWED):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.For):
+                pairs = [(node.target, node.iter)]
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                pairs = [(g.target, g.iter) for g in node.generators]
+            else:
+                continue
+            for tgt, it in pairs:
+                if "row" in _target_names(tgt) or _iter_is_per_row(it):
+                    findings.append(f"{rel}:{node.lineno}: {msg}")
+    return findings
+
+
 def run_ruff(root: str = REPO) -> Optional[List[str]]:
     """ruff findings, or None when ruff is not installed."""
     cmd = None
@@ -419,6 +488,7 @@ def main() -> int:
     findings += metric_lint(paths, trees)
     findings += resilience_lint(paths, trees)
     findings += io_seam_lint(paths, trees)
+    findings += row_loop_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

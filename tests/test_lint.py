@@ -153,6 +153,50 @@ class TestLintGate:
         assert "direct open() outside dmlc_tpu/io/" in kinds
         assert "direct os.stat() outside dmlc_tpu/io/" in kinds
 
+    def test_row_loop_gate_clean(self):
+        # no per-row Python loops over block payloads crept into
+        # dmlc_tpu/data/ or dmlc_tpu/pipeline/ outside the pinned
+        # golden-path allowlist — per-row work is engine (ABI-5 padded
+        # emission) or vectorized numpy (data.padding)
+        findings = lint.row_loop_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_row_loop_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "data",
+                           "_lintprobe3.py")
+        with open(bad, "w") as f:
+            f.write("def tally(block):\n"
+                    "    s = 0.0\n"
+                    "    for row in block:\n"
+                    "        s += float(row.label)\n"
+                    "    n = [block.label[i] "
+                    "for i in range(block.size)]\n"
+                    "    return s, n\n")
+        try:
+            findings = lint.row_loop_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 2, "\n".join(findings)
+        assert all("per-row Python loop" in f for f in findings)
+
+    def test_row_loop_gate_scope(self):
+        # block-level loops are fine; rowblock.py's Row protocol and
+        # files outside data//pipeline/ are exempt
+        probe = os.path.join(lint.REPO, "dmlc_tpu", "data",
+                             "_lintprobe3.py")
+        with open(probe, "w") as f:
+            f.write("def drain(parser):\n"
+                    "    return [b.nnz for b in parser]\n")
+        try:
+            assert lint.row_loop_lint([probe]) == []
+        finally:
+            os.remove(probe)
+        rb = os.path.join(lint.REPO, "dmlc_tpu", "data", "rowblock.py")
+        assert lint.row_loop_lint([rb]) == []  # pinned golden path
+        outside = os.path.join(lint.REPO, "dmlc_tpu", "parallel",
+                               "sharded.py")
+        assert lint.row_loop_lint([outside]) == []  # out of scope
+
     def test_io_seam_gate_exempts_io_package_and_allowlist(self):
         fsys = os.path.join(lint.REPO, "dmlc_tpu", "io", "filesys.py")
         assert lint.io_seam_lint([fsys]) == []
