@@ -30,7 +30,8 @@ Prints exactly ONE JSON line: {"metric", "value", "unit",
 "assemble_wait_s", "parse_cpu_gbps_core",
 "sustained_gauge_ok", "gauge_ok_epochs", "gauge_ok_threshold",
 "epoch_gauges", "gauge_bands", "run_band", "replay_gbps", "replay",
-"replay_tier", "handwired_gbps", "pipeline", "metrics", "trace"} —
+"replay_tier", "handwired_gbps", "pipeline", "metrics", "analysis",
+"trace"} —
 "value" is the SUSTAINED rate (20%-trimmed mean of per-epoch GB/s over
 >= 5 epochs / >= the time budget), "best_epoch" the fastest single
 epoch, "parse_cpu_gbps_core" the thread-CPU parse rate (immune to this
@@ -127,12 +128,19 @@ def main() -> None:
     # DMLC_TPU_SERVE_PORT set the measurement epochs are scrapeable
     # (curl :PORT/metrics) while they run; with DMLC_TPU_FLIGHT_DIR a
     # crash mid-bench leaves a post-mortem bundle
+    from dmlc_tpu.obs.aggregate import install_if_env as gang_if_env
     from dmlc_tpu.obs.flight import install_if_env
     from dmlc_tpu.obs.serve import serve_if_env
+    from dmlc_tpu.obs.timeseries import install_if_env as history_if_env
     srv = serve_if_env()
     if srv is not None:
         log(f"obs status server: http://127.0.0.1:{srv.port}/metrics")
+    # history BEFORE flight: flight joins an existing ring but installs
+    # its own 15 s one when none is running — the operator's
+    # DMLC_TPU_HISTORY_S/_BYTES must win
+    history_if_env()  # DMLC_TPU_HISTORY_S: /history + bundle history
     install_if_env()
+    gang_if_env()     # DMLC_TPU_GANG_POLL_S (rank 0): /gang timeline
     import jax
     import numpy as np
     from dmlc_tpu.data.parser import Parser
@@ -345,15 +353,9 @@ def main() -> None:
     # (1.6-3.0) and full-bucket (>= 3.0, a long-rested VM). Numbers
     # compare ACROSS runs only within one band; the run's modal band is
     # stamped so two BASELINE rows can be read side by side without
-    # rerunning either.
-    def gauge_band(g):
-        if g < 1.0:
-            return "drained"
-        if g < 1.6:
-            return "plateau"
-        if g < 3.0:
-            return "elevated"
-        return "full"
+    # rerunning either. The band cut points live in obs.analyze (the
+    # compare/attribution engine reads the same ones).
+    from dmlc_tpu.obs.analyze import gauge_band
 
     band_rates = {}
     for t, g in times:
@@ -475,6 +477,20 @@ def main() -> None:
         f"bound={bound} (pull-wait {pull_s:.2f}s vs xfer-wait "
         f"{xfer_s:.2f}s vs assemble-wait {asm_s:.2f}s in best epoch); "
         f"assembly_path={assembly_path}")
+    # The structured attribution verdict (obs.analyze): the best
+    # epoch's stage waits + the registry snapshot + the run's credit
+    # gauges, decomposed into a schema-pinned bound/evidence block —
+    # every campaign self-attributes instead of waiting for a human to
+    # read the stage numbers
+    analysis = None
+    if best_snap:
+        from dmlc_tpu.obs.analyze import attribute
+        analysis = attribute(best_snap, metrics=best_metrics,
+                             epoch_gauges=[g for _, g in times],
+                             run_band=run_band)
+        log(f"analysis: bound={analysis['bound']} "
+            f"({analysis['confidence']}) — "
+            + "; ".join(analysis["evidence"][:3]))
     print(json.dumps({
         "metric": "libsvm_parse_to_hbm_throughput",
         "value": round(sustained, 4),
@@ -531,6 +547,11 @@ def main() -> None:
         # obs metrics-registry snapshot taken at the best epoch
         # (schema: dmlc_tpu.obs.metrics.METRICS_SCHEMA)
         "metrics": best_metrics,
+        # the bottleneck-attribution verdict over the best epoch
+        # (schema: dmlc_tpu.obs.analyze.VERDICT_KEYS, lint-pinned):
+        # bound/band/confidence/evidence/stage_waits — what obsctl
+        # diagnose prints and the /analyze endpoint serves live
+        "analysis": analysis,
         # Chrome/Perfetto trace of the measurement epochs (--trace)
         "trace": trace_path,
     }))

@@ -34,11 +34,15 @@ def main() -> int:
     # per-rank status server under launch_local(serve_ports=...), the
     # crash flight recorder under launch_local(flight_dir=...), and the
     # rank-tagged gang trace under launch_local(trace_dir=...)
+    from dmlc_tpu.obs.aggregate import install_if_env as gang_if_env
     from dmlc_tpu.obs.flight import install_if_env
     from dmlc_tpu.obs.serve import serve_if_env
+    from dmlc_tpu.obs.timeseries import install_if_env as hist_if_env
     from dmlc_tpu.obs.trace import trace_if_env
     serve_if_env()
+    hist_if_env()     # before flight: DMLC_TPU_HISTORY_S must win
     install_if_env()
+    gang_if_env()     # DMLC_TPU_GANG_POLL_S (rank 0 only): /gang
     with trace_if_env():
         return _run()
 

@@ -34,6 +34,11 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
  12. native_assembly — ABI-5 native batch assembly vs the Python fused
                golden vs the sharded single-file parse, byte-parity
                pinned and speedup gauge-tagged (the r7 steady path)
+ 13. analyze — a short pipeline epoch run under the obs analysis
+               plane: the bottleneck-attribution verdict
+               (dmlc_tpu.obs.analyze, schema lint-pinned) must come
+               back non-empty and consistent with the measured stage
+               waits; the verdict rides in the JSON under "analysis"
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 
@@ -980,6 +985,55 @@ def bench_native_assembly(mb: int, gauge_fn=None) -> Dict:
     return out
 
 
+def bench_analyze(mb: int) -> Dict:
+    """Config 13: the analysis plane's acceptance probe. One short
+    declarative-pipeline epoch (criteo-shaped corpus, parse → padded
+    batch) attributed by dmlc_tpu.obs.analyze: the verdict must be
+    schema-valid (the lint-pinned VERDICT_KEYS — the same shape
+    bench.py embeds and /analyze serves), non-empty, and its bound
+    must be consistent with the measured stage waits (a bound naming a
+    component with zero measured wait would be fabricated evidence)."""
+    from dmlc_tpu.obs import analyze as obs_analyze
+    from dmlc_tpu.obs.metrics import REGISTRY
+    from dmlc_tpu.pipeline import Pipeline
+
+    path = f"{_TMP}.criteo.libsvm"
+    size = make_libsvm(path, mb, seed=7, nnz_range=(25, 45),
+                       index_space=10 ** 6, real_values=True)
+    built = (Pipeline.from_uri(path)
+             .parse(format="libsvm", engine="auto")
+             .batch(8 << 10, pad=True, nnz_bucket=(8 << 10) * 45)
+             .build())
+    before = (REGISTRY.snapshot().get("counters") or {})
+    snap = built.run_epoch()
+    metrics = REGISTRY.snapshot()
+    built.close()
+    # attribute() reads wire-side counters (objstore/pagestore) from
+    # the snapshot — delta them across THIS epoch so an earlier
+    # config's remote traffic (config 11 in a full-suite run) cannot
+    # flip a purely local epoch's verdict to wire-bound
+    metrics = dict(metrics)
+    metrics["counters"] = {
+        k: (v - before[k] if isinstance(v, (int, float))
+            and isinstance(before.get(k), (int, float)) else v)
+        for k, v in (metrics.get("counters") or {}).items()}
+    verdict = obs_analyze.attribute(snap, metrics=metrics)
+    assert sorted(verdict) == sorted(obs_analyze.VERDICT_KEYS), \
+        f"verdict drifted from VERDICT_KEYS: {sorted(verdict)}"
+    assert verdict["bound"] in obs_analyze.BOUNDS, verdict["bound"]
+    assert verdict["evidence"], "empty evidence"
+    assert verdict["stage_waits"]["stages"], "no per-stage waits"
+    sw = verdict["stage_waits"]
+    if verdict["bound"] in ("parse", "assemble", "xfer"):
+        key = {"parse": "parse_s", "assemble": "assemble_s",
+               "xfer": "xfer_s"}[verdict["bound"]]
+        assert sw[key] > 0, \
+            f"bound={verdict['bound']} with zero {key} measured"
+    return {"config": "analyze", "gbps": size / snap["wall_s"] / 1e9,
+            "bytes": size, "rows": snap["stages"][0]["rows"],
+            "wall_s": snap["wall_s"], "analysis": verdict}
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -993,13 +1047,14 @@ CONFIGS = {
     10: ("spill_replay", lambda mb, dev: bench_spill_replay(mb)),
     11: ("remote_hydrate", lambda mb, dev: bench_remote_hydrate(mb)),
     12: ("native_assembly", lambda mb, dev: bench_native_assembly(mb)),
+    13: ("analyze", lambda mb, dev: bench_analyze(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-12 (0 = all)")
+                    help="1-13 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -1029,12 +1084,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     # DMLC_TPU_SERVE_PORT makes the running configs scrapeable
     # (/metrics, /healthz), DMLC_TPU_FLIGHT_DIR leaves a post-mortem
     # bundle if a config dies badly
+    from dmlc_tpu.obs.aggregate import install_if_env as _gang_if_env
     from dmlc_tpu.obs.flight import install_if_env
     from dmlc_tpu.obs.serve import serve_if_env
+    from dmlc_tpu.obs.timeseries import install_if_env as _hist_if_env
     srv = serve_if_env()
     if srv is not None:
         _log(f"obs status server: http://127.0.0.1:{srv.port}/metrics")
+    # history before flight: flight installs a 15 s ring only when
+    # none is running — DMLC_TPU_HISTORY_S/_BYTES must win
+    _hist_if_env()
     install_if_env()
+    _gang_if_env()
     picks = [args.config] if args.config else sorted(CONFIGS)
     for n in picks:
         name, fn = CONFIGS[n]
@@ -1046,8 +1107,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             # epochs of one iterator, and config 11's cold epoch IS the
             # measurement (a warm pass would hydrate the pages it's
             # about to time) — a second full run of any would be pure
-            # wasted minutes
-            if not args.cold and n not in (7, 8, 9, 10, 11):
+            # wasted minutes; config 13's verdict probe is not a perf
+            # number at all, warming it buys nothing
+            if not args.cold and n not in (7, 8, 9, 10, 11, 13):
                 fn(args.mb, args.device)  # warm imports + page cache
             trace_path = None
             if args.trace:

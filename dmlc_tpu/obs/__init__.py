@@ -20,13 +20,25 @@ Python and native layers (docs/observability.md):
   /stacks, on-demand /trace capture) + gang scraping;
 - :mod:`~dmlc_tpu.obs.flight` — the always-on crash flight recorder
   (small trace ring + periodic metrics, post-mortem bundle on
-  uncaught exception, fatal signal, or watchdog-confirmed stall).
+  uncaught exception, fatal signal, or watchdog-confirmed stall);
+- :mod:`~dmlc_tpu.obs.timeseries` — the ANALYSIS substrate: a
+  bounded, downsampling ring of periodic metric samples shared by
+  /history, stall reports, and crash bundles;
+- :mod:`~dmlc_tpu.obs.aggregate` — rank-0 gang aggregation onto one
+  wall-anchored timeline (per-rank series, rollups, explicit
+  unreachable-rank gaps; served at /gang);
+- :mod:`~dmlc_tpu.obs.analyze` — bottleneck attribution (the
+  structured bound verdict bench.py embeds and /analyze serves) and
+  band-aware BENCH-to-BENCH regression comparison.
 """
 
+from dmlc_tpu.obs.aggregate import GangAggregator
+from dmlc_tpu.obs.analyze import attribute, compare, gauge_band
 from dmlc_tpu.obs.export import (
     chrome_events, merge_chrome_files, write_chrome,
 )
 from dmlc_tpu.obs.flight import FlightRecorder
+from dmlc_tpu.obs.timeseries import TimeSeriesRing
 from dmlc_tpu.obs.log import warn_limited, warn_once
 from dmlc_tpu.obs.metrics import (
     METRICS_SCHEMA, REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
@@ -50,4 +62,6 @@ __all__ = [
     "Watchdog", "warn_once", "warn_limited",
     "StatusServer", "serve", "render_prometheus", "scrape_gang",
     "FlightRecorder",
+    "TimeSeriesRing", "GangAggregator",
+    "attribute", "compare", "gauge_band",
 ]

@@ -1,0 +1,403 @@
+"""Bottleneck attribution: turn recorded telemetry into a verdict.
+
+The BENCH r1→r7 trajectory (0.34 → 0.58 sustained GB/s) has been
+interpreted by a human reading JSON files: stage waits here, credit
+gauges there, assembly path in a third key. This module is that
+judgment as code, decomposing an epoch from data the plane ALREADY
+records — ``StageProbe`` wait totals, the device stage's
+``xfer_wait_s``/``staging_assemble_s`` extras, the fused engine's
+``assemble_s``, pagestore/objstore hit counters, and the credit-gauge
+bands bench.py computes — into one structured verdict:
+
+``{"schema": 1, "bound": "parse" | "assemble" | "xfer" | "wire" |
+"credit-limited" | "consumer", "band": <credit band>, "confidence":
+"high" | "medium" | "low", "evidence": [...], "stage_waits": {...}}``
+
+The key set is pinned by ``scripts/lint.py``'s verdict-schema gate (a
+literal-dict key check like the metric-name gate), so the ``/analyze``
+endpoint, bench.py's embedded ``"analysis"`` block, and
+``scripts/obsctl.py`` can never drift apart. Every evidence entry
+names the MEASURED quantity it rests on — two legs with different
+stage waits can share a ``bound`` but never share evidence.
+
+The second half is regression judgment: :func:`compare` diffs two
+BENCH JSONs band-for-band (BASELINE.md's credit-recovery bands), so
+in-band credit variance — the ~10x wall-rate swing this burstable
+host's credit scheduler causes — is reported as variance, and only an
+out-of-tolerance delta WITHIN one comparability band flags as a
+regression.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["attribute", "compare", "compare_files", "load_bench",
+           "diagnose_bench", "gauge_band", "VERDICT_KEYS", "BOUNDS",
+           "ANALYSIS_SCHEMA", "DEFAULT_TOLERANCE"]
+
+# bump when the verdict's top-level shape changes incompatibly
+ANALYSIS_SCHEMA = 1
+
+# the verdict's pinned key set — scripts/lint.py's verdict-schema gate
+# checks every literal verdict dict in the package against this tuple
+VERDICT_KEYS = ("schema", "bound", "band", "confidence", "evidence",
+                "stage_waits")
+
+BOUNDS = ("parse", "assemble", "xfer", "wire", "credit-limited",
+          "consumer")
+
+# in-band delta tolerated before compare() flags a regression: the
+# BENCH_r0* archive shows ~±12% sustained-rate spread across same-band
+# reruns with no code change (credit/thermal climate), so the default
+# sits just above it
+DEFAULT_TOLERANCE = 0.15
+
+# below this fraction of wall spent waiting on ANY stage, the pipeline
+# is not the bottleneck — whoever consumes it is
+_CONSUMER_WAIT_FRAC = 0.15
+
+
+def gauge_band(g: Optional[float]) -> str:
+    """Credit-comparability band of one host-memcpy gauge reading
+    (BASELINE.md "Credit-recovery profile"). The ONE implementation —
+    bench.py and compare() both read bands through here."""
+    if g is None:
+        return "unknown"
+    if g < 1.0:
+        return "drained"
+    if g < 1.6:
+        return "plateau"
+    if g < 3.0:
+        return "elevated"
+    return "full"
+
+
+def _modal_band(gauges: Optional[List[float]]) -> str:
+    if not gauges:
+        return "unknown"
+    counts: Dict[str, int] = {}
+    for g in gauges:
+        b = gauge_band(g)
+        counts[b] = counts.get(b, 0) + 1
+    return max(counts, key=lambda b: counts[b])
+
+
+def _counter(metrics: Optional[Dict[str, Any]], name: str) -> float:
+    if not metrics:
+        return 0.0
+    v = (metrics.get("counters") or {}).get(name)
+    return float(v) if isinstance(v, (int, float)) else 0.0
+
+
+def attribute(pipeline_snap: Dict[str, Any],
+              metrics: Optional[Dict[str, Any]] = None,
+              epoch_gauges: Optional[List[float]] = None,
+              run_band: Optional[str] = None) -> Dict[str, Any]:
+    """Decompose one epoch into a bound verdict.
+
+    ``pipeline_snap`` is a pipeline stats snapshot
+    (``PIPELINE_STATS_SCHEMA``: ``CompiledPipeline.stats()``, the
+    ``pipeline`` collector in a registry snapshot, or the ``pipeline``
+    block of a BENCH JSON). ``metrics`` is an optional registry
+    snapshot for the wire-side counters (pagestore/objstore hit
+    rates). ``epoch_gauges``/``run_band`` carry bench.py's credit
+    gauges when available — without them the credit-limited bound
+    cannot be claimed and the verdict says so.
+    """
+    stages = list(pipeline_snap.get("stages") or [])
+    wall = float(pipeline_snap.get("wall_s") or 0.0)
+    per_stage: Dict[str, float] = {}
+    parse_s = assemble_s = xfer_s = 0.0
+    assembly_path = None
+    occupancies: List[Tuple[str, float]] = []
+    fused_first = False
+    fused_assemble = 0.0
+    for i, st in enumerate(stages):
+        name = str(st.get("name", "?"))
+        kind = st.get("kind")
+        wait = float(st.get("wait_s") or 0.0)
+        per_stage[name] = round(wait, 6)
+        x = st.get("extra") or {}
+        if kind == "parse":
+            parse_s += wait
+        elif i == 0 and kind == "assemble":
+            # the fused native rung (ABI-5) folds parse INTO the first
+            # assemble-kind stage: its delivery wait is the parse side,
+            # with THIS stage's own measured assemble seconds carved
+            # out below (not the whole pipeline's — downstream staging
+            # assembly belongs to other stages). Only the fused shape
+            # earns the credit — a cache- or shard-first pipeline's
+            # stage-0 wait is replay/shard I/O, not parsing.
+            parse_s += wait
+            fused_first = True
+            fused_assemble = (float(x.get("assemble_s") or 0.0)
+                              + float(x.get("staging_assemble_s")
+                                      or 0.0))
+        assemble_s += float(x.get("assemble_s") or 0.0)
+        assemble_s += float(x.get("staging_assemble_s") or 0.0)
+        xfer_s += float(x.get("xfer_wait_s") or 0.0)
+        if x.get("assembly_path"):
+            assembly_path = x["assembly_path"]
+        occ = st.get("queue_occupancy")
+        if occ is not None:
+            occupancies.append((name, float(occ)))
+    if fused_first:
+        parse_s = max(0.0, parse_s - fused_assemble)
+    total_wait = sum(per_stage.values())
+
+    # wire side: pagestore hit rate + objstore GET traffic (cumulative
+    # process counters — a cold remote epoch shows misses and GETs)
+    ps_hit = _counter(metrics, "pagestore.hit")
+    ps_miss = _counter(metrics, "pagestore.miss")
+    obj_gets = _counter(metrics, "objstore.get")
+    obj_bytes = _counter(metrics, "objstore.bytes")
+    hit_rate = (ps_hit / (ps_hit + ps_miss)
+                if (ps_hit + ps_miss) else None)
+    pipeline_bytes = max((int(st.get("bytes") or 0) for st in stages),
+                         default=0)
+    wire_heavy = (obj_gets > 0 and obj_bytes >= 0.5 * pipeline_bytes
+                  and (hit_rate is None or hit_rate < 0.5))
+
+    band = run_band or _modal_band(epoch_gauges)
+    evidence: List[str] = []
+    waits = {"parse": parse_s, "assemble": assemble_s, "xfer": xfer_s}
+
+    if band != "unknown":
+        mean_g = (round(sum(epoch_gauges) / len(epoch_gauges), 2)
+                  if epoch_gauges else None)
+        evidence.append(
+            f"credit band {band}"
+            + (f" (mean memcpy gauge {mean_g} GB/s over "
+               f"{len(epoch_gauges)} epochs)" if mean_g is not None
+               else ""))
+    for comp, s in sorted(waits.items(), key=lambda kv: -kv[1]):
+        if s > 0:
+            frac = f" = {s / wall:.0%} of wall" if wall > 0 else ""
+            evidence.append(f"{comp} wait {round(s, 4)}s{frac}")
+    if assembly_path:
+        evidence.append(f"assembly_path={assembly_path}")
+    if hit_rate is not None:
+        evidence.append(f"pagestore hit rate {hit_rate:.2f} "
+                        f"({int(ps_hit)} hit / {int(ps_miss)} miss)")
+    if obj_gets:
+        evidence.append(f"objstore: {int(obj_gets)} GETs, "
+                        f"{int(obj_bytes)} wire bytes vs "
+                        f"{pipeline_bytes} pipeline bytes")
+    for name, occ in occupancies:
+        if occ >= 0.8:
+            evidence.append(f"queue {name} {occ:.0%} full "
+                            "(producer outpacing consumer)")
+
+    # the decision ladder: climate first (a drained credit bucket
+    # swamps every in-pipeline signal), then the wire, then whichever
+    # measured wait dominates, with tiny-wait epochs handed to the
+    # consumer
+    ranked = sorted(waits.items(), key=lambda kv: -kv[1])
+    top_name, top_s = ranked[0]
+    second_s = ranked[1][1]
+    if band == "drained":
+        bound = "credit-limited"
+        confidence = "high"
+        evidence.insert(0, "modal gauge band is drained: wall rates "
+                        "reflect the credit scheduler, not the "
+                        "pipeline")
+    elif wire_heavy:
+        bound = "wire"
+        confidence = "high" if (hit_rate or 0) < 0.2 else "medium"
+    elif wall > 0 and total_wait < _CONSUMER_WAIT_FRAC * wall:
+        bound = "consumer"
+        confidence = "high" if total_wait < 0.05 * wall else "medium"
+        evidence.insert(0, f"stage waits total {round(total_wait, 4)}s "
+                        f"= {total_wait / wall:.0%} of wall "
+                        f"{round(wall, 4)}s — the pipeline is not the "
+                        "bottleneck")
+    elif top_s <= 0:
+        bound = "consumer"
+        confidence = "low"
+        evidence.insert(0, "no stage reported a wait; defaulting to "
+                        "consumer-bound")
+    else:
+        bound = top_name
+        if second_s <= 0 or top_s >= 2.0 * second_s:
+            confidence = "high"
+        elif top_s >= 1.2 * second_s:
+            confidence = "medium"
+        else:
+            confidence = "low"
+            evidence.append(
+                f"close call: {ranked[0][0]} {round(top_s, 4)}s vs "
+                f"{ranked[1][0]} {round(second_s, 4)}s")
+    return {
+        "schema": ANALYSIS_SCHEMA,
+        "bound": bound,
+        "band": band,
+        "confidence": confidence,
+        "evidence": evidence,
+        "stage_waits": {
+            "parse_s": round(parse_s, 6),
+            "assemble_s": round(assemble_s, 6),
+            "xfer_s": round(xfer_s, 6),
+            "total_wait_s": round(total_wait, 6),
+            "wall_s": round(wall, 6),
+            "stages": per_stage,
+        },
+    }
+
+
+# ------------------------------------------------------- BENCH compare
+
+def load_bench(path_or_doc) -> Dict[str, Any]:
+    """Load a BENCH JSON: either the raw one-line dict bench.py
+    prints, or the campaign wrapper the BENCH_r0*.json archive uses
+    (``{"n", "cmd", "rc", "tail", "parsed"}`` — ``parsed`` is the
+    bench line; older wrappers may only carry it inside ``tail``)."""
+    if isinstance(path_or_doc, dict):
+        doc = path_or_doc
+    else:
+        with open(path_or_doc) as f:
+            doc = json.load(f)
+    if "metric" in doc or "pipeline" in doc:
+        return doc
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    for line in reversed((doc.get("tail") or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                inner = json.loads(line)
+            except ValueError:
+                continue
+            if "metric" in inner:
+                return inner
+    raise ValueError("not a BENCH JSON (no metric/parsed/tail line)")
+
+
+def _bands_of(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Per-band sustained rates of one BENCH doc. Prefers the
+    ``gauge_bands`` block (present since r6); older docs fall back to
+    their modal band (from ``epoch_gauges``) carrying
+    ``sustained_gauge_ok``/``value`` — and a doc with no gauges at all
+    lands in band "unknown", comparable only with another unknown."""
+    out: Dict[str, Dict[str, Any]] = {}
+    gb = doc.get("gauge_bands")
+    if isinstance(gb, dict):
+        for band, v in gb.items():
+            if isinstance(v, dict) and v.get("sustained") is not None:
+                out[band] = {"sustained": v["sustained"],
+                             "epochs": v.get("epochs")}
+    if out:
+        return out
+    band = doc.get("run_band") or _modal_band(doc.get("epoch_gauges"))
+    value = doc.get("sustained_gauge_ok")
+    if value is None:
+        value = doc.get("value")
+    if value is not None:
+        out[band] = {"sustained": value, "epochs": doc.get("epochs")}
+    return out
+
+
+def compare(doc_a: Dict[str, Any], doc_b: Dict[str, Any],
+            tolerance: float = DEFAULT_TOLERANCE) -> Dict[str, Any]:
+    """Band-aware diff of two BENCH docs (a = baseline, b = candidate).
+
+    Rates are compared WITHIN one credit band only; a band present in
+    just one run is reported ``incomparable`` (the climate differed,
+    not necessarily the code). ``parse_cpu_gbps_core`` — the
+    credit-immune kernel rate — is compared across the whole run
+    regardless of band. Deltas within ±``tolerance`` are ``in-band``
+    variance, never regressions."""
+    a, b = load_bench(doc_a), load_bench(doc_b)
+    bands_a, bands_b = _bands_of(a), _bands_of(b)
+    rows: Dict[str, Dict[str, Any]] = {}
+    regressions: List[str] = []
+    improvements: List[str] = []
+    for band in sorted(set(bands_a) | set(bands_b)):
+        ra, rb = bands_a.get(band), bands_b.get(band)
+        if ra is None or rb is None:
+            rows[band] = {"a": ra and ra["sustained"],
+                          "b": rb and rb["sustained"],
+                          "epochs": [ra and ra.get("epochs"),
+                                     rb and rb.get("epochs")],
+                          "delta_frac": None,
+                          "status": "incomparable"}
+            continue
+        va, vb = float(ra["sustained"]), float(rb["sustained"])
+        delta = (vb - va) / va if va else None
+        if delta is None:
+            status = "incomparable"
+        elif delta < -tolerance:
+            status = "regression"
+            regressions.append(
+                f"band {band}: {va} -> {vb} GB/s ({delta:+.1%})")
+        elif delta > tolerance:
+            status = "improvement"
+            improvements.append(
+                f"band {band}: {va} -> {vb} GB/s ({delta:+.1%})")
+        else:
+            status = "in-band"
+        rows[band] = {"a": va, "b": vb,
+                      "epochs": [ra.get("epochs"), rb.get("epochs")],
+                      "delta_frac": (round(delta, 4)
+                                     if delta is not None else None),
+                      "status": status}
+    cpu = None
+    ca, cb = a.get("parse_cpu_gbps_core"), b.get("parse_cpu_gbps_core")
+    if ca and cb:
+        delta = (cb - ca) / ca
+        status = ("regression" if delta < -tolerance else
+                  "improvement" if delta > tolerance else "in-band")
+        if status == "regression":
+            regressions.append(
+                f"parse_cpu_gbps_core (credit-immune): {ca} -> {cb} "
+                f"({delta:+.1%})")
+        elif status == "improvement":
+            improvements.append(
+                f"parse_cpu_gbps_core (credit-immune): {ca} -> {cb} "
+                f"({delta:+.1%})")
+        cpu = {"a": ca, "b": cb, "delta_frac": round(delta, 4),
+               "status": status}
+    return {
+        "schema": ANALYSIS_SCHEMA,
+        "tolerance": tolerance,
+        "a": {"value": a.get("value"), "run_band": a.get("run_band"),
+              "bound": a.get("bound"), "epochs": a.get("epochs")},
+        "b": {"value": b.get("value"), "run_band": b.get("run_band"),
+              "bound": b.get("bound"), "epochs": b.get("epochs")},
+        "bands": rows,
+        "parse_cpu": cpu,
+        "regressions": regressions,
+        "improvements": improvements,
+    }
+
+
+def compare_files(path_a: str, path_b: str,
+                  tolerance: float = DEFAULT_TOLERANCE
+                  ) -> Dict[str, Any]:
+    return compare(load_bench(path_a), load_bench(path_b),
+                   tolerance=tolerance)
+
+
+def diagnose_bench(path_or_doc) -> Dict[str, Any]:
+    """Attribute a finished BENCH run offline from its embedded
+    telemetry (pipeline stage snapshot + registry snapshot + epoch
+    gauges) — obsctl's ``diagnose BENCH.json`` path. Prefers the
+    run's own embedded ``analysis`` when present (re-deriving would
+    hide what the run itself claimed)."""
+    doc = load_bench(path_or_doc)
+    if isinstance(doc.get("analysis"), dict):
+        return doc["analysis"]
+    pipeline = doc.get("pipeline") or {}
+    snap = {"stages": pipeline.get("stages") or [], "wall_s": None}
+    # the BENCH doc carries no wall_s at top level; derive it from the
+    # best epoch's rate when possible
+    if doc.get("best_epoch") and doc.get("metric"):
+        stages = snap["stages"]
+        nbytes = max((int(s.get("bytes") or 0) for s in stages),
+                     default=0)
+        if nbytes:
+            snap["wall_s"] = nbytes / (float(doc["best_epoch"]) * 1e9)
+    return attribute(snap, metrics=doc.get("metrics"),
+                     epoch_gauges=doc.get("epoch_gauges"),
+                     run_band=doc.get("run_band"))

@@ -13,9 +13,12 @@ badly:
   ``trace_to``/``start()`` displaces it for the explicit trace's
   duration and ``stop()`` reinstates it — always-on costs one branch
   plus one ring append per event, exactly the tracing-on price;
-- a periodic metrics sampler (daemon thread) keeping the last K
-  registry snapshots, so the bundle shows the minutes BEFORE the
-  crash, not just the final state;
+- the SHARED time-series ring (:mod:`dmlc_tpu.obs.timeseries`): the
+  recorder installs the process history ring when none is running yet
+  (period ``metrics_interval_s``), so the bundle's ``history.json``
+  shows the minutes BEFORE the crash — the SAME samples a live
+  ``GET /history`` query would have returned, not a private sampler's
+  parallel universe;
 - crash hooks: ``sys.excepthook`` + ``threading.excepthook`` (dump on
   uncaught exceptions), ``faulthandler`` writing fatal-signal stacks
   into the bundle dir (SIGSEGV leaves ``fatal.txt`` even though no
@@ -30,6 +33,7 @@ Bundle layout (one timestamped dir per process under ``out_dir``)::
       MANIFEST.json   # reason, time, pid/rank, what else is here
       trace.json      # Chrome/Perfetto export of the active ring
       metrics.json    # current snapshot + the periodic history
+      history.json    # the shared time-series ring's full dump
       watchdog.json   # live blocked waits + past stall reports
       stacks.txt      # all-thread Python stacks at dump time
       env.json        # argv, python, platform, DMLC_*/JAX_* env
@@ -54,7 +58,6 @@ import tempfile
 import threading
 import time
 import traceback
-from collections import deque
 from typing import Any, Dict, Optional
 
 from dmlc_tpu.obs import trace as _trace
@@ -77,12 +80,13 @@ class FlightRecorder:
     def __init__(self, out_dir: Optional[str] = None,
                  ring_capacity: int = 4096,
                  metrics_interval_s: float = 15.0,
-                 metrics_keep: int = 8,
                  keep_bundles: int = 5):
         self.out_dir = out_dir or default_flight_dir()
         self.ring = _trace.TraceRecorder(ring_capacity)
         self.metrics_interval_s = float(metrics_interval_s)
-        self._metrics_history: deque = deque(maxlen=int(metrics_keep))
+        # the shared obs.timeseries ring this recorder installed (None
+        # when one was already running: that one is read, not owned)
+        self._owned_history = None
         self.keep_bundles = max(1, int(keep_bundles))
         stamp = time.strftime("%Y%m%d-%H%M%S")
         self.bundle_dir = os.path.join(
@@ -92,8 +96,6 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._installed = False
         self._fatal_file = None
-        self._stop = threading.Event()
-        self._sampler: Optional[threading.Thread] = None
         self._prev_excepthook = None
         self._prev_threading_hook = None
 
@@ -120,11 +122,14 @@ class FlightRecorder:
         threading.excepthook = self._on_thread_exception
         _watchdog.set_escalation(self._on_stall)
         atexit.register(self._at_exit)
-        self._stop.clear()
-        self._sampler = threading.Thread(
-            target=self._sample_loop, daemon=True,
-            name="dmlc_tpu.obs.FlightSampler")
-        self._sampler.start()
+        # the black box needs history: join the process time-series
+        # ring, installing one (at this recorder's interval) only when
+        # none is running — crash bundles and live /history queries
+        # must read the SAME ring
+        from dmlc_tpu.obs import timeseries as _ts
+        if _ts.active() is None:
+            self._owned_history = _ts.install(
+                period_s=self.metrics_interval_s)
         self._installed = True
         return self
 
@@ -132,10 +137,11 @@ class FlightRecorder:
         if not self._installed:
             return
         self._installed = False
-        self._stop.set()
-        if self._sampler is not None:
-            self._sampler.join(timeout=2.0)
-            self._sampler = None
+        from dmlc_tpu.obs import timeseries as _ts
+        if (self._owned_history is not None
+                and _ts.active() is self._owned_history):
+            _ts.uninstall()
+        self._owned_history = None
         if sys.excepthook is self._on_exception:
             sys.excepthook = self._prev_excepthook or sys.__excepthook__
         if threading.excepthook is self._on_thread_exception:
@@ -187,16 +193,6 @@ class FlightRecorder:
             try:
                 shutil.rmtree(os.path.join(self.out_dir, stale))
             except OSError:
-                pass
-
-    # -- periodic metrics deltas
-
-    def _sample_loop(self) -> None:
-        while not self._stop.wait(self.metrics_interval_s):
-            try:
-                self._metrics_history.append(
-                    {"time": time.time(), "snapshot": REGISTRY.snapshot()})
-            except Exception:  # noqa: BLE001 — sampler must survive
                 pass
 
     # -- crash hooks
@@ -280,11 +276,25 @@ class FlightRecorder:
                 snap = REGISTRY.snapshot()
             except Exception as e:  # noqa: BLE001
                 snap = {"error": repr(e)}
+            # history comes from the SHARED time-series ring (one
+            # last sample is forced so even a crash early in a period
+            # window carries the final state)
+            history = None
+            try:
+                from dmlc_tpu.obs import timeseries as _ts
+                ring = _ts.active()
+                if ring is not None:
+                    ring.sample_now(force=True)
+                    history = ring.to_dict()
+            except Exception:  # noqa: BLE001 — optional section
+                history = None
             _write_json("metrics.json", {
                 "current": snap,
-                "history": list(self._metrics_history),
+                "history": (history or {}).get("samples") or [],
                 "interval_s": self.metrics_interval_s,
             })
+            if history is not None:
+                _write_json("history.json", history)
             try:
                 from dmlc_tpu.resilience import inject as _inject
                 plan = _inject.active()

@@ -118,10 +118,37 @@ class Histogram:
             b = self._bucket(v)
             self._buckets[b] = self._buckets.get(b, 0) + 1
 
+    def _quantile_locked(self, q: float) -> Optional[float]:
+        """Estimate the q-quantile from the log2 buckets: walk the
+        cumulative counts to the target bucket, interpolate linearly
+        inside it (bucket lower bound = upper/2 for log2 buckets),
+        clamp to the observed min/max. Called with the lock held."""
+        if not self.count:
+            return None
+        target = q * self.count
+        cum = 0
+        for ub, n in sorted(self._buckets.items()):
+            prev = cum
+            cum += n
+            if cum >= target:
+                lo = 0.0 if ub <= 0 else ub / 2.0
+                est = lo + (ub - lo) * ((target - prev) / n)
+                if self.min is not None:
+                    est = max(est, self.min)
+                if self.max is not None:
+                    est = min(est, self.max)
+                return round(est, 9)
+        return self.max
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             return {"count": self.count, "sum": round(self.total, 9),
                     "min": self.min, "max": self.max,
+                    # bucket-walk estimates (exact only at bucket
+                    # edges; clamped to min/max) — the at-a-glance
+                    # latency numbers /metrics renders per histogram
+                    "p50": self._quantile_locked(0.5),
+                    "p99": self._quantile_locked(0.99),
                     "buckets": {repr(k): v for k, v in
                                 sorted(self._buckets.items())}}
 

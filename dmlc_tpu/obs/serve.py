@@ -22,7 +22,16 @@ rank serves:
 - ``GET /trace?seconds=N`` — an on-demand bounded Perfetto capture of
   the RUNNING pipeline: installs a recorder for N seconds when none is
   active (restoring the flight ring after), or lets an active ring
-  accumulate N more seconds, then returns the Chrome trace-event JSON.
+  accumulate N more seconds, then returns the Chrome trace-event JSON;
+- ``GET /history[?seconds=N]`` — the shared time-series ring
+  (:mod:`dmlc_tpu.obs.timeseries`): this rank's metric history,
+  optionally trimmed to the trailing N seconds;
+- ``GET /gang[?seconds=N]`` — the gang aggregator's merged view
+  (:mod:`dmlc_tpu.obs.aggregate`, rank 0 / launcher): per-rank series,
+  rollups, explicit unreachable-rank gaps;
+- ``GET /analyze`` — a bottleneck-attribution verdict
+  (:mod:`dmlc_tpu.obs.analyze`) over the last completed pipeline
+  epoch's stage stats + the current registry snapshot.
 
 ``launch_local(serve_ports=[...])`` hands every worker a port via
 ``DMLC_TPU_SERVE_PORT`` (workers opt in with one :func:`serve_if_env`
@@ -172,6 +181,17 @@ def render_prometheus(snap: Dict[str, Any],
         lines.append(f'{pn}_bucket{{le="+Inf"}} {h.get("count", 0)}')
         lines.append(f"{pn}_sum {_num(h.get('sum') or 0)}")
         lines.append(f"{pn}_count {h.get('count', 0)}")
+        # bucket-estimated quantiles as sibling gauge families (a
+        # histogram family admits no extra series of its own)
+        for qk in ("p50", "p99"):
+            qv = h.get(qk)
+            if _is_num(qv):
+                qn = f"{pn}_{qk}"
+                lines.append(f"# HELP {qn} Histogram {name} {qk} "
+                             "estimate (log2 buckets, clamped to "
+                             "min/max).")
+                lines.append(f"# TYPE {qn} gauge")
+                lines.append(f"{qn} {_num(qv)}")
     leaves: List[tuple] = []
     for cname, payload in sorted((snap.get("collectors") or {}).items()):
         flat: List[tuple] = []
@@ -268,12 +288,54 @@ class _Handler(BaseHTTPRequestHandler):
                 q = parse_qs(url.query)
                 seconds = float(q.get("seconds", ["1"])[0])
                 self._send_json(_capture_trace(seconds))
+            elif url.path == "/history":
+                from dmlc_tpu.obs import timeseries as _ts
+                ring = _ts.active()
+                if ring is None:
+                    self._send_json(
+                        {"error": "no timeseries ring installed",
+                         "hint": "set DMLC_TPU_HISTORY_S (launch_local"
+                                 "(history_s=...)) or call "
+                                 "obs.timeseries.install()"},
+                        code=404)
+                else:
+                    q = parse_qs(url.query)
+                    raw = q.get("seconds", [None])[0]
+                    last_s = float(raw) if raw else None
+                    self._send_json(ring.to_dict(last_s=last_s))
+            elif url.path == "/gang":
+                from dmlc_tpu.obs import aggregate as _agg
+                agg = _agg.active()
+                if agg is None:
+                    self._send_json(
+                        {"error": "no gang aggregator installed",
+                         "hint": "set DMLC_TPU_GANG_POLL_S (launch_"
+                                 "local(gang_poll_s=...)) or call "
+                                 "obs.aggregate.install()"},
+                        code=404)
+                else:
+                    q = parse_qs(url.query)
+                    raw = q.get("seconds", [None])[0]
+                    last_s = float(raw) if raw else None
+                    self._send_json(agg.view(last_s=last_s))
+            elif url.path == "/analyze":
+                verdict = owner.analyze_verdict()
+                if verdict is None:
+                    self._send_json(
+                        {"error": "no pipeline stats to attribute "
+                                  "(no registered pipeline collector "
+                                  "has completed an epoch yet)"},
+                        code=404)
+                else:
+                    self._send_json(verdict)
             else:
                 self._send_json({"error": "unknown endpoint",
                                  "endpoints": ["/metrics",
                                                "/metrics.json",
                                                "/healthz", "/stacks",
-                                               "/trace?seconds=N"]},
+                                               "/trace?seconds=N",
+                                               "/history", "/gang",
+                                               "/analyze"]},
                                 code=404)
         except Exception as e:  # noqa: BLE001 — a scrape must never
             try:                # take down the serving thread
@@ -298,9 +360,53 @@ class StatusServer:
             target=self._httpd.serve_forever, daemon=True,
             name="dmlc_tpu.obs.StatusServer")
         self._thread.start()
+        # /analyze wire-counter scoping: (epoch, closing counters of
+        # the PREVIOUS epoch, baseline used for this epoch) — see
+        # analyze_verdict()
+        self._analyze_lock = threading.Lock()
+        self._analyze_prev = None
         # the port is itself telemetry: a merged gang snapshot tells
         # the reader where each rank can be curled
         self.registry.gauge("obs.serve_port").set(self.port)
+
+    def analyze_verdict(self) -> Optional[Dict[str, Any]]:
+        """The /analyze payload: attribute the last completed epoch of
+        the first live pipeline collector. Wire-side counters
+        (objstore/pagestore) are process-cumulative in the registry, so
+        they are DELTA-scoped here against the counters seen when the
+        previous epoch closed — earlier remote work (a cold hydration
+        configs ago) must not flip a purely local epoch's verdict to
+        wire-bound. The very first call has no baseline and reads
+        cumulative counters; within one epoch, repeated polls reuse the
+        same baseline so the verdict is stable."""
+        from dmlc_tpu.obs import analyze as _an
+        snap = self.registry.snapshot()
+        pipeline = next(
+            (v for k, v in sorted(
+                (snap.get("collectors") or {}).items())
+             if k.startswith("pipeline") and v), None)
+        if pipeline is None:
+            return None
+        counters = dict(snap.get("counters") or {})
+        epoch = pipeline.get("epoch")
+        with self._analyze_lock:
+            prev = self._analyze_prev
+            if prev is None:
+                baseline = None
+                self._analyze_prev = (epoch, counters, None)
+            elif epoch != prev[0]:
+                baseline = prev[1]
+                self._analyze_prev = (epoch, counters, baseline)
+            else:
+                baseline = prev[2]
+        if baseline:
+            snap = dict(snap)
+            snap["counters"] = {
+                k: (v - baseline[k] if isinstance(v, (int, float))
+                    and isinstance(baseline.get(k), (int, float))
+                    else v)
+                for k, v in counters.items()}
+        return _an.attribute(pipeline, metrics=snap)
 
     def health(self) -> Dict[str, Any]:
         from dmlc_tpu.obs import trace as _trace

@@ -12,8 +12,10 @@ than ``threshold_s`` produces ONE diagnosis report per stall naming
 the blocked stage(s), how long each has been blocked, the live detail
 each wait carries (queue occupancy/capacity, producer counters, replay
 tier), a full metrics-registry snapshot (spill state, engine stats —
-whatever the process registered), and ``faulthandler`` stacks of every
-thread. The report lands as JSON at ``report_path`` (plus a warning
+whatever the process registered), the trailing ``history_s`` of
+time-series samples when the shared :mod:`dmlc_tpu.obs.timeseries`
+ring is installed (the decay INTO the stall, not just the frozen end
+state), and ``faulthandler`` stacks of every thread. The report lands as JSON at ``report_path`` (plus a warning
 through obs.log) and in ``self.reports`` for tests/tooling.
 """
 
@@ -110,8 +112,12 @@ class Watchdog:
                  interval_s: Optional[float] = None,
                  report_path: Optional[str] = None,
                  on_stall: Optional[Callable[[Dict[str, Any]], None]]
-                 = None, keep_reports: int = 8):
+                 = None, keep_reports: int = 8,
+                 history_s: float = 120.0):
         self.threshold_s = float(threshold_s)
+        # how much time-series history to attach to each report (the
+        # decay INTO the stall; needs the shared obs.timeseries ring)
+        self.history_s = float(history_s)
         self.interval_s = (interval_s if interval_s is not None
                            else max(0.05, min(1.0, threshold_s / 4)))
         self.report_path = report_path
@@ -207,6 +213,19 @@ class Watchdog:
             metrics = REGISTRY.snapshot()
         except Exception as e:  # noqa: BLE001
             metrics = {"error": repr(e)}
+        # the trailing history_s of time-series samples: the frozen
+        # end state (metrics above) shows WHERE it stalled, the decay
+        # into it shows WHEN the rates started dying — empty when no
+        # shared ring is installed
+        history: List[Dict[str, Any]] = []
+        try:
+            from dmlc_tpu.obs import timeseries as _ts
+            ring = _ts.active()
+            if ring is not None:
+                ring.sample_now(force=True)
+                history = ring.last(self.history_s)
+        except Exception:  # noqa: BLE001 — diagnostics must not raise
+            history = []
         return {
             "kind": "dmlc_tpu_stall_report",
             "time": time.time(),
@@ -214,6 +233,8 @@ class Watchdog:
             "threshold_s": self.threshold_s,
             "blocked": blocked,
             "metrics": metrics,
+            "history": history,
+            "history_s": self.history_s,
             "stacks": _thread_stacks(),
         }
 

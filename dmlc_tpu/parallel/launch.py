@@ -49,6 +49,11 @@ ENV_TRACE_DIR = "DMLC_TPU_TRACE_DIR"
 ENV_SERVE_PORT = "DMLC_TPU_SERVE_PORT"    # this worker's status port
 ENV_SERVE_PORTS = "DMLC_TPU_SERVE_PORTS"  # comma-joined gang ports
 ENV_FLIGHT_DIR = "DMLC_TPU_FLIGHT_DIR"    # crash-bundle output dir
+# analysis-plane env contract (launch_local(history_s=...) /
+# launch_local(gang_poll_s=...)): workers opt in with one call each —
+# obs.timeseries.install_if_env() and obs.aggregate.install_if_env()
+ENV_HISTORY_S = "DMLC_TPU_HISTORY_S"      # time-series sample period
+ENV_GANG_POLL_S = "DMLC_TPU_GANG_POLL_S"  # rank-0 gang-poll period
 # resilience contracts (dmlc_tpu.resilience): launch_local(faults=...)
 # sets DMLC_TPU_FAULTS for every member; the gang supervisor sets
 # DMLC_TPU_ATTEMPT (alias DMLC_NUM_ATTEMPT — the reference's rejoin
@@ -199,6 +204,8 @@ def launch_local(num_workers: int, command: Sequence[str],
                  trace_dir: Optional[str] = None,
                  serve_ports=None,
                  flight_dir: Optional[str] = None,
+                 history_s: Optional[float] = None,
+                 gang_poll_s: Optional[float] = None,
                  restart_policy=None,
                  faults=None) -> List[int]:
     """Run N worker processes on this host (reference: local.py).
@@ -252,6 +259,19 @@ def launch_local(num_workers: int, command: Sequence[str],
     ``obs.flight.install_if_env()`` leave a post-mortem bundle there
     when they die badly (uncaught exception, fatal signal, confirmed
     stall) — the black box for the gang member that took everyone down.
+
+    ``history_s`` hands every worker the time-series contract
+    (``DMLC_TPU_HISTORY_S``): workers that call
+    ``obs.timeseries.install_if_env()`` sample their metrics registry
+    at that period into the shared bounded ring — served live at
+    ``/history``, attached to stall reports and crash bundles.
+
+    ``gang_poll_s`` sets ``DMLC_TPU_GANG_POLL_S`` on RANK 0 ONLY:
+    with ``serve_ports`` also wired, a rank-0
+    ``obs.aggregate.install_if_env()`` call polls every peer's
+    ``/metrics.json`` at that period into one gang timeline (per-rank
+    series + sum/min/max rollups + explicit unreachable gaps), served
+    at rank 0's ``/gang``.
 
     Returns the list of exit codes (workers first in task-id order,
     then scheduler, then servers). Raises if any process fails.
@@ -329,6 +349,10 @@ def launch_local(num_workers: int, command: Sequence[str],
             wenv[ENV_SERVE_PORTS] = ",".join(map(str, serve_ports))
         if flight_dir is not None:
             wenv[ENV_FLIGHT_DIR] = flight_dir
+        if history_s is not None:
+            wenv[ENV_HISTORY_S] = str(history_s)
+        if gang_poll_s is not None and task_id == 0:
+            wenv[ENV_GANG_POLL_S] = str(gang_poll_s)
         if ps_root is not None:
             wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
                                 num_servers, "worker", task_id))

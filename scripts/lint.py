@@ -28,6 +28,13 @@ without the tools baked in:
   not ad-hoc control flow. The two pre-resilience skip-not-retry
   handlers are pinned in an allowlist; the list shrinks, it does not
   grow.
+- **Verdict-schema gate** (always run, AST-based): the analysis
+  verdict's key set (``dmlc_tpu/obs/analyze.py`` ``VERDICT_KEYS``) is
+  pinned here, and any literal dict that claims to be a verdict
+  (``"bound"`` + ``"evidence"`` keys) anywhere in ``dmlc_tpu/`` or
+  ``scripts/`` must match it exactly — the ``/analyze`` endpoint,
+  bench JSON ``"analysis"`` blocks, and ``scripts/obsctl.py`` can
+  never drift apart.
 - **Steady-path gate** (always run, AST-based): inside
   ``dmlc_tpu/data/`` and ``dmlc_tpu/pipeline/``, per-row Python loops
   over block payloads (``for row in …`` or ``range(<x>.size)`` index
@@ -240,6 +247,7 @@ def metric_lint(paths: List[str],
 IO_SEAM_ALLOWED = {
     "dmlc_tpu/bench_mp_worker.py",   # gang-worker result JSON
     "dmlc_tpu/bench_suite.py",       # corpus builders / BENCH JSON
+    "dmlc_tpu/obs/analyze.py",       # BENCH result JSON (compare)
     "dmlc_tpu/obs/export.py",        # trace JSON export
     "dmlc_tpu/obs/flight.py",        # crash flight bundles
     "dmlc_tpu/obs/watchdog.py",      # stall reports
@@ -443,6 +451,85 @@ def row_loop_lint(paths: List[str],
     return findings
 
 
+# The analysis-verdict schema (dmlc_tpu/obs/analyze.py VERDICT_KEYS):
+# the /analyze endpoint, bench.py's embedded "analysis" block, config
+# 13's acceptance assert, and scripts/obsctl.py all read THIS key set.
+# The pin below is the one source of truth the gate checks everything
+# against — change the schema by changing both, consciously.
+VERDICT_KEYS = ("schema", "bound", "band", "confidence", "evidence",
+                "stage_waits")
+_ANALYZE_REL = "dmlc_tpu/obs/analyze.py"
+
+
+def _const_str_keys(node: ast.Dict) -> Optional[List[str]]:
+    """The dict's keys when ALL are string constants, else None (a
+    dynamic key means the dict is not a literal verdict shape)."""
+    keys = []
+    for k in node.keys:
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        keys.append(k.value)
+    return keys
+
+
+def verdict_lint(paths: List[str],
+                 trees: Optional[dict] = None) -> List[str]:
+    """The verdict-schema gate: every literal dict that claims to BE a
+    verdict (carries both a "bound" and an "evidence" string key) must
+    carry exactly the pinned VERDICT_KEYS, and obs/analyze.py's
+    VERDICT_KEYS tuple must equal the pin. Scanned over dmlc_tpu/ and
+    scripts/ — the CLI consumes the same schema."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    scan: List[tuple] = [trees[p] for p in paths if p in trees]
+    scripts_dir = os.path.join(REPO, "scripts")
+    for path in paths:
+        rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+        if not path.startswith(scripts_dir + os.sep):
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                scan.append((rel, ast.parse(f.read(), filename=rel)))
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            pass
+    findings: List[str] = []
+    pin_seen = False
+    for rel, tree in scan:
+        for node in ast.walk(tree):
+            if (rel == _ANALYZE_REL and isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "VERDICT_KEYS"
+                            for t in node.targets)):
+                pin_seen = True
+                v = node.value
+                vals = (tuple(e.value for e in v.elts
+                              if isinstance(e, ast.Constant))
+                        if isinstance(v, (ast.Tuple, ast.List))
+                        else None)
+                if vals != VERDICT_KEYS:
+                    findings.append(
+                        f"{rel}:{node.lineno}: VERDICT_KEYS {vals!r} "
+                        f"drifted from the lint pin {VERDICT_KEYS!r} "
+                        "— the /analyze endpoint, bench JSON and "
+                        "obsctl share this schema; change both "
+                        "consciously")
+            if isinstance(node, ast.Dict):
+                keys = _const_str_keys(node)
+                if (keys is not None and "bound" in keys
+                        and "evidence" in keys
+                        and sorted(keys) != sorted(VERDICT_KEYS)):
+                    findings.append(
+                        f"{rel}:{node.lineno}: verdict-shaped dict "
+                        f"with keys {sorted(keys)} != the pinned "
+                        f"schema {sorted(VERDICT_KEYS)} — build "
+                        "verdicts with dmlc_tpu.obs.analyze."
+                        "attribute(), never by hand")
+    if any(rel == _ANALYZE_REL for rel, _ in scan) and not pin_seen:
+        findings.append(f"{_ANALYZE_REL}:0: VERDICT_KEYS tuple "
+                        "missing (the verdict-schema gate pins it)")
+    return findings
+
+
 def run_ruff(root: str = REPO) -> Optional[List[str]]:
     """ruff findings, or None when ruff is not installed."""
     cmd = None
@@ -489,6 +576,7 @@ def main() -> int:
     findings += resilience_lint(paths, trees)
     findings += io_seam_lint(paths, trees)
     findings += row_loop_lint(paths, trees)
+    findings += verdict_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

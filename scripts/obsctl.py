@@ -1,0 +1,327 @@
+#!/usr/bin/env python
+"""obsctl — operator CLI over the live obs plane and BENCH archives.
+
+Subcommands (all read-only; the plane stays in charge):
+
+- ``top``      — live top-style per-stage table of a running rank's
+                 pipeline (polls ``/metrics.json``; ``--once`` for a
+                 single frame);
+- ``diagnose`` — one-shot bottleneck verdict: from a live rank's
+                 ``/analyze`` endpoint, or offline from a BENCH JSON
+                 (prefers the run's own embedded ``"analysis"``);
+- ``compare``  — band-aware diff of two BENCH JSONs (gauge bands from
+                 BASELINE.md): in-band credit variance reports as
+                 variance, only out-of-tolerance same-band deltas flag
+                 as regressions (exit 3 when any do);
+- ``history``  — a rank's ``/history`` time-series ring, summarized;
+- ``gang``     — rank 0's ``/gang`` merged gang view (per-rank
+                 reachability, gaps, rollups), summarized.
+
+Port defaults to ``DMLC_TPU_SERVE_PORT`` so ``obsctl top`` inside a
+gang worker's environment needs no flags.
+
+Examples::
+
+    python scripts/obsctl.py top --port 9100
+    python scripts/obsctl.py diagnose --port 9100
+    python scripts/obsctl.py diagnose BENCH_r07.json
+    python scripts/obsctl.py compare BENCH_r06.json BENCH_r07.json
+    python scripts/obsctl.py gang --port 9100
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable from anywhere, no install step
+    sys.path.insert(0, REPO)
+
+
+def _fetch(port: int, path: str, host: str = "127.0.0.1",
+           timeout_s: float = 5.0) -> Dict[str, Any]:
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                    timeout=timeout_s) as resp:
+            return json.load(resp)
+    except urllib.error.HTTPError as e:
+        # the server's 404s carry a JSON {error, hint} payload (e.g.
+        # "no timeseries ring installed" + how to enable it) — return
+        # it so the subcommands can show the hint instead of a bare
+        # HTTP status line
+        try:
+            payload = json.load(e)
+        except Exception:  # noqa: BLE001 — non-JSON body: original err
+            raise e from None
+        return payload
+
+
+def _default_port(args) -> int:
+    if args.port:
+        return args.port
+    env = os.environ.get("DMLC_TPU_SERVE_PORT")
+    if env:
+        return int(env)
+    raise SystemExit("no --port given and DMLC_TPU_SERVE_PORT unset")
+
+
+def _pipeline_of(snap: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    for k, v in sorted((snap.get("collectors") or {}).items()):
+        if k.startswith("pipeline") and v:
+            return v
+    return None
+
+
+def _fmt(v: Any, nd: int = 2) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render_stage_table(pl: Dict[str, Any]) -> str:
+    """One pipeline stats snapshot -> an aligned per-stage table."""
+    cols = ["stage", "kind", "items", "rows", "MB", "wait_s", "wait%",
+            "GB/s", "q"]
+    rows: List[List[str]] = []
+    for st in pl.get("stages") or []:
+        occ = st.get("queue_occupancy")
+        q = (f"{st.get('queue_depth_mean')}/{st.get('queue_cap')}"
+             if st.get("queue_cap") else "-")
+        rows.append([
+            str(st.get("name", "?")), str(st.get("kind", "?")),
+            _fmt(st.get("items")), _fmt(st.get("rows")),
+            _fmt((st.get("bytes") or 0) / 1e6, 1),
+            _fmt(st.get("wait_s"), 3),
+            (f"{st['wait_frac']:.0%}"
+             if st.get("wait_frac") is not None else "-"),
+            _fmt(st.get("throughput_gbps"), 3),
+            q + (f" ({occ:.0%})" if occ is not None else ""),
+        ])
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows
+              else len(c) for i, c in enumerate(cols)]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    lines.append(f"epoch {pl.get('epoch')}  wall {pl.get('wall_s')}s  "
+                 f"knobs {pl.get('knobs')}")
+    return "\n".join(lines)
+
+
+def render_verdict(v: Dict[str, Any]) -> str:
+    lines = [f"bound: {v.get('bound')}   band: {v.get('band')}   "
+             f"confidence: {v.get('confidence')}"]
+    sw = v.get("stage_waits") or {}
+    lines.append(
+        f"waits: parse {_fmt(sw.get('parse_s'), 3)}s  assemble "
+        f"{_fmt(sw.get('assemble_s'), 3)}s  xfer "
+        f"{_fmt(sw.get('xfer_s'), 3)}s  (total "
+        f"{_fmt(sw.get('total_wait_s'), 3)}s of wall "
+        f"{_fmt(sw.get('wall_s'), 3)}s)")
+    lines.append("evidence:")
+    for e in v.get("evidence") or []:
+        lines.append(f"  - {e}")
+    return "\n".join(lines)
+
+
+def render_compare(r: Dict[str, Any]) -> str:
+    lines = [f"tolerance ±{r['tolerance']:.0%} within a credit band "
+             "(BASELINE.md bands; cross-band reads are incomparable)"]
+    header = ["band", "epochs a/b", "a GB/s", "b GB/s", "delta",
+              "status"]
+    rows: List[List[str]] = []
+    for band, row in (r.get("bands") or {}).items():
+        ea, eb = (row.get("epochs") or [None, None])[:2]
+        rows.append([
+            band, f"{_fmt(ea)}/{_fmt(eb)}", _fmt(row.get("a"), 4),
+            _fmt(row.get("b"), 4),
+            (f"{row['delta_frac']:+.1%}"
+             if row.get("delta_frac") is not None else "-"),
+            row.get("status", "-")])
+    cpu = r.get("parse_cpu")
+    if cpu:
+        rows.append(["cpu-core*", "-", _fmt(cpu["a"], 4),
+                     _fmt(cpu["b"], 4), f"{cpu['delta_frac']:+.1%}",
+                     cpu["status"]])
+    widths = [max(len(c), *(len(x[i]) for x in rows)) if rows
+              else len(c) for i, c in enumerate(header)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(header, widths)))
+    for x in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(x, widths)))
+    if cpu:
+        lines.append("(* parse_cpu_gbps_core: credit-immune, compared "
+                     "across bands)")
+    for reg in r.get("regressions") or []:
+        lines.append(f"REGRESSION: {reg}")
+    for imp in r.get("improvements") or []:
+        lines.append(f"improvement: {imp}")
+    if not r.get("regressions"):
+        lines.append("no regressions outside in-band variance")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    port = _default_port(args)
+    while True:
+        snap = _fetch(port, "/metrics.json", host=args.host)
+        pl = _pipeline_of(snap)
+        stamp = time.strftime("%H:%M:%S")
+        who = (f"rank {snap.get('rank')}" if snap.get("rank") is not None
+               else f"pid {snap.get('pid')}")
+        print(f"— obsctl top · {who} · :{port} · {stamp} —")
+        if pl is None:
+            print("no pipeline collector yet (no CompiledPipeline has "
+                  "completed an epoch in this process)")
+        else:
+            print(render_stage_table(pl))
+        if args.once:
+            return 0
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+def cmd_diagnose(args) -> int:
+    from dmlc_tpu.obs.analyze import diagnose_bench
+    if args.bench:
+        v = diagnose_bench(args.bench)
+    else:
+        port = _default_port(args)
+        v = _fetch(port, "/analyze", host=args.host)
+        if "bound" not in v:
+            print(json.dumps(v))
+            return 2
+    if args.json:
+        print(json.dumps(v))
+    else:
+        print(render_verdict(v))
+    return 0
+
+
+def cmd_compare(args) -> int:
+    from dmlc_tpu.obs.analyze import compare_files
+    r = compare_files(args.a, args.b, tolerance=args.tolerance)
+    if args.json:
+        print(json.dumps(r))
+    else:
+        print(render_compare(r))
+    return 3 if r["regressions"] else 0
+
+
+def cmd_history(args) -> int:
+    port = _default_port(args)
+    path = "/history" + (f"?seconds={args.seconds}" if args.seconds
+                         else "")
+    h = _fetch(port, path, host=args.host)
+    if args.json or "samples" not in h:
+        print(json.dumps(h))
+        return 0 if "samples" in h else 2
+    samples = h["samples"]
+    span = (samples[-1]["t"] - samples[0]["t"]) if len(samples) > 1 \
+        else 0.0
+    print(f"{len(samples)} samples spanning {span:.1f}s at "
+          f"~{h['resolution_s']}s resolution "
+          f"({h['approx_bytes']}/{h['budget_bytes']} bytes, "
+          f"{h['coarsenings']} coarsenings)")
+    if samples:
+        for key in sorted(samples[-1]["v"])[:args.keys]:
+            first = next((s["v"][key] for s in samples
+                          if key in s["v"]), None)
+            print(f"  {key}: {first} -> {samples[-1]['v'][key]}")
+    return 0
+
+
+def cmd_gang(args) -> int:
+    port = _default_port(args)
+    g = _fetch(port, "/gang", host=args.host)
+    if args.json or "ranks" not in g:
+        print(json.dumps(g))
+        return 0 if "ranks" in g else 2
+    print(f"gang of {len(g['ports'])} (poll {g['period_s']}s, "
+          f"{g['polls']} polls)")
+    for label, m in sorted(g["ranks"].items()):
+        state = "UNREACHABLE" if m["unreachable"] else "up"
+        gaps = len(m["gaps"])
+        kept = m["series"]["kept"]
+        print(f"  {label} :{m['port']}  {state}  "
+              f"{m['polls_ok']} ok / {m['polls_failed']} failed"
+              + (f"  {gaps} gap(s)" if gaps else "")
+              + f"  {kept} samples"
+              + (f"  last error {m['last_error']}"
+                 if m["last_error"] else ""))
+    roll = g["rollup"]["samples"]
+    if roll:
+        last = roll[-1]["v"]
+        print(f"  rollup: reachable {last.get('gang.reachable')}/"
+              f"{last.get('gang.expected')} at last poll, "
+              f"{len(roll)} rollup samples")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="obsctl", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--port", type=int, default=0,
+                       help="status-server port (default: "
+                            "DMLC_TPU_SERVE_PORT)")
+        p.add_argument("--host", default="127.0.0.1")
+        p.add_argument("--json", action="store_true",
+                       help="raw JSON output")
+
+    p = sub.add_parser("top", help="live per-stage pipeline table")
+    common(p)
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--once", action="store_true")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("diagnose",
+                       help="bottleneck verdict (live rank or BENCH "
+                            "JSON)")
+    common(p)
+    p.add_argument("bench", nargs="?", default=None,
+                   help="BENCH JSON to diagnose offline")
+    p.set_defaults(fn=cmd_diagnose)
+
+    p = sub.add_parser("compare",
+                       help="band-aware diff of two BENCH JSONs")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("--tolerance", type=float, default=None)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_compare)
+
+    p = sub.add_parser("history", help="a rank's time-series ring")
+    common(p)
+    p.add_argument("--seconds", type=float, default=None)
+    p.add_argument("--keys", type=int, default=12,
+                   help="series keys to summarize")
+    p.set_defaults(fn=cmd_history)
+
+    p = sub.add_parser("gang", help="rank 0's merged gang view")
+    common(p)
+    p.set_defaults(fn=cmd_gang)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "compare" and args.tolerance is None:
+        from dmlc_tpu.obs.analyze import DEFAULT_TOLERANCE
+        args.tolerance = DEFAULT_TOLERANCE
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+    except (OSError, urllib.error.URLError) as e:
+        print(f"obsctl: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -197,6 +197,53 @@ class TestLintGate:
                                "sharded.py")
         assert lint.row_loop_lint([outside]) == []  # out of scope
 
+    def test_verdict_gate_clean(self):
+        # the analysis-verdict key set matches the pin everywhere a
+        # literal verdict dict appears (dmlc_tpu/ + scripts/) and
+        # obs/analyze.py's VERDICT_KEYS tuple equals it
+        findings = lint.verdict_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_verdict_gate_pin_matches_analyze(self):
+        # the two sources of truth agree (change both consciously)
+        from dmlc_tpu.obs.analyze import VERDICT_KEYS
+        assert tuple(VERDICT_KEYS) == tuple(lint.VERDICT_KEYS)
+
+    def test_verdict_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe4.py")
+        with open(bad, "w") as f:
+            f.write("def fake():\n"
+                    "    return {'bound': 'parse', 'evidence': [],\n"
+                    "            'extra_key': 1}\n")
+        try:
+            findings = lint.verdict_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 1, "\n".join(findings)
+        assert "verdict-shaped dict" in findings[0]
+
+    def test_verdict_gate_scans_scripts_too(self):
+        bad = os.path.join(lint.REPO, "scripts", "_lintprobe5.py")
+        with open(bad, "w") as f:
+            f.write("V = {'bound': 'xfer', 'evidence': []}\n")
+        try:
+            findings = lint.verdict_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 1 and "obsctl" not in findings[0]
+
+    def test_verdict_gate_requires_pin_in_analyze(self, tmp_path):
+        # a drifted VERDICT_KEYS tuple in analyze.py is a finding —
+        # simulate by linting a fake tree rooted at the analyze path
+        import ast as _ast
+        fake = ("VERDICT_KEYS = ('schema', 'bound')\n")
+        tree = _ast.parse(fake)
+        findings = []
+        probe = os.path.join(lint.REPO, "dmlc_tpu", "obs", "analyze.py")
+        findings = lint.verdict_lint(
+            [probe], trees={probe: (lint._ANALYZE_REL, tree)})
+        assert any("drifted from the lint pin" in f for f in findings)
+
     def test_io_seam_gate_exempts_io_package_and_allowlist(self):
         fsys = os.path.join(lint.REPO, "dmlc_tpu", "io", "filesys.py")
         assert lint.io_seam_lint([fsys]) == []

@@ -36,13 +36,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture(autouse=True)
 def _obs_clean():
-    """Tracing off, no flight recorder, fresh log state per test."""
+    """Tracing off, no flight recorder, no history ring/aggregator,
+    fresh log state per test."""
+    from dmlc_tpu.obs import aggregate as obs_agg
+    from dmlc_tpu.obs import timeseries as obs_ts
     obs_flight.uninstall()
+    obs_ts.uninstall()
+    obs_agg.uninstall()
     obs_trace.stop()
     obs_trace.clear_fallback()
     obs_log.reset()
     yield
     obs_flight.uninstall()
+    obs_ts.uninstall()
+    obs_agg.uninstall()
     obs_trace.stop()
     obs_trace.clear_fallback()
     obs_log.reset()
@@ -122,6 +129,11 @@ class TestPrometheusExposition:
         assert 'dmlc_wait_s_bucket{le="+Inf"} 2' in text
         assert "\ndmlc_wait_s_count 2\n" in text
         assert "\ndmlc_wait_s_sum 0.75\n" in text
+        # bucket-estimated quantiles as sibling gauge families
+        assert "# TYPE dmlc_wait_s_p50 gauge" in text
+        assert "# TYPE dmlc_wait_s_p99 gauge" in text
+        assert "\ndmlc_wait_s_p50 " in text
+        assert "\ndmlc_wait_s_p99 0.5\n" in text  # clamped to max
         # collector numeric leaves, flattened + labeled; strings dropped
         assert ('dmlc_collector_value{collector="queue/demo",'
                 'key="qsize"} 2') in text
