@@ -155,6 +155,37 @@ def make_recordio(prefix: str, mb: int, nparts: int = 4,
     return paths
 
 
+def make_dense_recordio(path: str, mb: int, seed: int = 0,
+                        n_range=(24, 48)) -> int:
+    """Dense .rec corpus for config 14: RecordIO-framed dense records
+    (the frozen ABI-6 payload ``u32 n | f32 label | f32[n] values``)
+    with a sprinkle of values whose f32 bits equal the frame magic, so
+    the escaped multi-frame decode path runs inside the measured
+    epoch (not just in unit tests)."""
+    import struct
+
+    from dmlc_tpu.io.recordio import (DenseRecordWriter, RECORDIO_MAGIC)
+    from dmlc_tpu.io.stream import create_stream
+    if os.path.exists(path) and os.path.getsize(path) >= (mb << 20) * 3 // 4:
+        return os.path.getsize(path)
+    rng = np.random.RandomState(seed)
+    magic_f32 = np.frombuffer(struct.pack("<I", RECORDIO_MAGIC),
+                              "<f4")[0]
+    with create_stream(path, "w") as s:
+        w = DenseRecordWriter(s)
+        written = 0
+        i = 0
+        while written < (mb << 20):
+            n = int(rng.randint(*n_range))
+            vals = rng.rand(n).astype(np.float32)
+            if i % 251 == 0:
+                vals[n // 2] = magic_f32
+            w.write(float(i % 7) - 3.0, vals)
+            written += 16 + 4 * n
+            i += 1
+    return os.path.getsize(path)
+
+
 def make_indexed_recordio(path: str, mb: int, seed: int = 0) -> int:
     """ImageNet-.rec-shaped single file + .idx (key\\toffset) index."""
     from dmlc_tpu.io.recordio import IndexedRecordIOWriter
@@ -851,6 +882,53 @@ def bench_remote_hydrate(mb: int) -> Dict:
         hits = REGISTRY.counter("pagestore.hit").value - hit0
         misses = REGISTRY.counter("pagestore.miss").value - miss0
         best = min(walls)
+
+        # compressed-hydrate variant (the codec PR): the SAME cold
+        # epoch with the page codec on — ranges travel as codec frames
+        # (decoded under the io.objstore.get retry seam), hydrated
+        # blocks land encoded. Wire bytes must drop by the corpus's
+        # measured compression ratio, the second epoch must still be
+        # wire-free, and the bytes must stay identical to the
+        # uncompressed run.
+        prev_level = objstore.options().get("codec_level")
+        objstore.configure(codec_level=6)
+        try:
+            for name in os.listdir(store.root) \
+                    if os.path.isdir(store.root) else []:
+                if name.startswith("obj-"):
+                    store.delete(name)
+            em.reset_counters()
+            czw, czh, _ = epoch()
+            ccold = em.counters()
+            assert czh == local_hash, \
+                "compressed remote epoch diverged from the local bytes"
+            em.reset_counters()
+            czw2, czh2, _ = epoch()
+            cwarm = em.counters()
+            assert czh2 == local_hash
+        finally:
+            # restore the pre-variant codec option exactly even when an
+            # assert fires (main() catches per-config errors and keeps
+            # running the suite — a leaked codec_level=6 would silently
+            # compress every later config's remote reads). None =
+            # process default; configure() treats None as "keep", so
+            # set directly.
+            from dmlc_tpu.io.objstore import fs as _objfs
+            _objfs._options["codec_level"] = prev_level
+        compressed = {
+            "hydrate_gbps": round(size / czw / 1e9, 4),
+            "cold_gets": ccold["gets"],
+            "cold_wire_bytes": ccold["get_bytes"],
+            "wire_ratio": round(
+                cold["get_bytes"] / max(ccold["get_bytes"], 1), 2),
+            "warm_gets": cwarm["gets"],
+            "warm_wall_s": round(czw2, 3),
+        }
+        assert ccold["get_bytes"] < cold["get_bytes"], \
+            "codec moved no fewer wire bytes"
+        assert cwarm["gets"] == 0, \
+            f"compressed warm epoch hit the wire: {cwarm['gets']} GETs"
+
         return {"config": "remote_hydrate", "gbps": size / best / 1e9,
                 "bytes": size,
                 "hydrate_gbps": round(size / cold_wall / 1e9, 4),
@@ -862,6 +940,7 @@ def bench_remote_hydrate(mb: int) -> Dict:
                 "replay_epoch_walls": [round(w, 3) for w in walls],
                 "wire": {"latency_s": em.latency_s,
                          "bandwidth_gbps": em.bandwidth_gbps},
+                "compressed": compressed,
                 "hash": cold_hash}
     finally:
         objstore.configure(None)
@@ -1034,6 +1113,111 @@ def bench_analyze(mb: int) -> Dict:
             "wall_s": snap["wall_s"], "analysis": verdict}
 
 
+def bench_recio_native(mb: int, gauge_fn=None) -> Dict:
+    """Config 14 (the ABI-6 PR): native dense-RecordIO decode vs the
+    Python golden, one gauge-tagged run. A dense .rec corpus (frozen
+    payload contract, escaped-magic records included) runs through
+    ``parse(format="recordio_dense") → batch(pad=True)`` three ways —
+    engine=python (the data/dense_record_parser.py golden),
+    engine=native (RecordIOShardReader → engine-side dense decode →
+    fused ABI-5 padded emission), and engine=native with ``shards=2``
+    (one .rec split across two native parsers on magic-realigned byte
+    ranges) — with every path's padded batches hashed in an UNTIMED
+    parity pass: all three streams must be sha256-identical. The
+    native contenders' epochs INTERLEAVE so speedups share one credit
+    climate (the config-12 discipline); the ``outstanding()`` probe
+    pins that after an epoch the padded lease was the only live lease
+    (arenas recycled at cut)."""
+    import hashlib
+
+    from dmlc_tpu.pipeline import Pipeline
+
+    if gauge_fn is None:
+        from dmlc_tpu.bench_transfer import memcpy_gauge
+        gauge_fn = memcpy_gauge
+    path = f"{_TMP}.dense.rec"
+    size = make_dense_recordio(path, mb, seed=11)
+    rows = 8 << 10
+    nnz_bucket = rows * 48
+
+    def build(engine, shards=None):
+        kw = {"shards": shards} if shards else {}
+        return (Pipeline.from_uri(path)
+                .parse(format="recordio_dense", engine=engine, **kw)
+                .batch(rows, pad=True, nnz_bucket=nnz_bucket)
+                .build())
+
+    def measure(built, state):
+        state.setdefault("walls", []).append(0.0)
+        state.setdefault("gauges", []).append(round(gauge_fn(), 2))
+        t0 = time.perf_counter()
+        for _ in built:
+            pass
+        state["walls"][-1] = time.perf_counter() - t0
+        # leak probe: between epochs NO lease may stay out (the last
+        # padded lease releases on the epoch's terminal pull)
+        parser = getattr(built._runners[0], "_parser", None)
+        if parser is not None and hasattr(parser, "outstanding"):
+            state["outstanding"] = int(parser.outstanding())
+
+    def finish(built, state):
+        snap = built.stats()
+        apath = next((x["assembly_path"] for s in snap["stages"]
+                      if (x := s.get("extra") or {}).get("assembly_path")),
+                     None)
+        h = hashlib.sha256()
+        n = 0
+        for b in built:
+            for k in sorted(b):
+                h.update(k.encode())
+                h.update(np.ascontiguousarray(b[k]).tobytes())
+            n += 1
+        built.close()
+        return {"gbps": round(size / min(state["walls"]) / 1e9, 4),
+                "epoch_walls": [round(w, 3) for w in state["walls"]],
+                "epoch_gauges": state["gauges"],
+                "assembly_path": apath, "batches": n,
+                "outstanding_after_epoch": state.get("outstanding"),
+                "hash": h.hexdigest()}
+
+    from dmlc_tpu import native
+    py_built, py_state = build("python"), {}
+    measure(py_built, py_state)
+    py = finish(py_built, py_state)
+    out = {"config": "recio_native", "bytes": size, "rows": rows,
+           "nnz_bucket": nnz_bucket, "python": py,
+           "gbps": py["gbps"], "hash": py["hash"],
+           "epoch_gauges": py["epoch_gauges"]}
+    if native.native_available():
+        contenders = {"native": build("native"),
+                      "sharded": build("native", shards=2)}
+        states = {k: {} for k in contenders}
+        for _ in range(3):
+            for k, b in contenders.items():
+                measure(b, states[k])
+        nat = finish(contenders["native"], states["native"])
+        sh = finish(contenders["sharded"], states["sharded"])
+        assert nat["assembly_path"] == "native-padded", \
+            f"native dense decode fell back to {nat['assembly_path']}"
+        for name, r in (("native", nat), ("sharded", sh)):
+            assert r["hash"] == py["hash"], \
+                f"{name} dense stream diverged from the python golden"
+            assert r["outstanding_after_epoch"] == 0, \
+                f"{name}: {r['outstanding_after_epoch']} leases leaked"
+        out.update({
+            "native": nat, "sharded": sh, "gbps": nat["gbps"],
+            "epoch_gauges": nat["epoch_gauges"],
+            "speedup_native_vs_python": round(
+                nat["gbps"] / py["gbps"], 3),
+            "speedup_sharded_vs_native": round(
+                sh["gbps"] / nat["gbps"], 3)})
+    else:
+        out.update({"native": None, "sharded": None,
+                    "speedup_native_vs_python": None,
+                    "speedup_sharded_vs_native": None})
+    return out
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -1048,13 +1232,14 @@ CONFIGS = {
     11: ("remote_hydrate", lambda mb, dev: bench_remote_hydrate(mb)),
     12: ("native_assembly", lambda mb, dev: bench_native_assembly(mb)),
     13: ("analyze", lambda mb, dev: bench_analyze(mb)),
+    14: ("recio_native", lambda mb, dev: bench_recio_native(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-13 (0 = all)")
+                    help="1-14 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -1108,8 +1293,11 @@ def main(argv: Optional[List[str]] = None) -> None:
             # measurement (a warm pass would hydrate the pages it's
             # about to time) — a second full run of any would be pure
             # wasted minutes; config 13's verdict probe is not a perf
-            # number at all, warming it buys nothing
-            if not args.cold and n not in (7, 8, 9, 10, 11, 13):
+            # number at all, warming it buys nothing; config 14 already
+            # interleaves 3 native epochs per contender (self-warming —
+            # and its python-golden leg is ~100x the native one, so a
+            # warm pass would double the slowest part of the suite)
+            if not args.cold and n not in (7, 8, 9, 10, 11, 13, 14):
                 fn(args.mb, args.device)  # warm imports + page cache
             trace_path = None
             if args.trace:

@@ -35,7 +35,8 @@ PARSER_REGISTRY = Registry.get("ParserFactory")
 # native_or's class-name → format-string map for the sharded dispatch
 _NATIVE_FORMATS = {"NativeLibSVMParser": "libsvm",
                    "NativeCSVParser": "csv",
-                   "NativeLibFMParser": "libfm"}
+                   "NativeLibFMParser": "libfm",
+                   "NativeDenseRecordParser": "recordio_dense"}
 
 
 def native_or(native_cls_name: str, python_cls, kwargs):

@@ -29,7 +29,14 @@ Read path (:class:`ObjectSeekStream`):
   never silently passed downstream;
 - wire traffic is counted (``objstore.get``, ``objstore.bytes``,
   rendered ``dmlc_objstore_*_total``) and hydration hits/misses ride
-  the page-store counters.
+  the page-store counters;
+- with a page-codec level (``configure(codec_level=N)`` or the
+  ``DMLC_TPU_PAGE_CODEC_LEVEL`` process default) ranges travel
+  COMPRESSED (``get_encoded`` transfer coding, decoded inside the
+  retry seam) and hydrated blocks are stored as codec frames (the
+  sidecar stamps which): ``objstore.bytes`` counts compressed on-wire
+  bytes, ``objstore.bytes_served`` the decompressed payload — see
+  docs/remote_io.md "Page compression" for when the trade pays.
 
 Hydrated entries are stamped with the object's ``[uri, size, mtime]``
 fingerprint AND keyed by its etag: a changed object changes the key
@@ -67,6 +74,9 @@ _options = {
     "coalesce": 4,            # max adjacent missing blocks per span
     "parallel": 4,            # concurrent ranged GETs per span
     "hydrate": True,          # write fetched blocks into the PageStore
+    "codec_level": None,      # io.codec level for the wire + hydrated
+                              # pages; None = the process default
+                              # (DMLC_TPU_PAGE_CODEC_LEVEL), 0 = raw
 }
 
 
@@ -79,7 +89,8 @@ def configure(client_obj=_KEEP, *, root: Optional[str] = None,
               block_bytes: Optional[int] = None,
               coalesce: Optional[int] = None,
               parallel: Optional[int] = None,
-              hydrate: Optional[bool] = None):
+              hydrate: Optional[bool] = None,
+              codec_level: Optional[int] = None):
     """Install the process's object-store client (or build an
     :class:`~dmlc_tpu.io.objstore.emulator.EmulatedObjectStore` over
     ``root``) and tune the read path. Returns the installed client.
@@ -104,7 +115,8 @@ def configure(client_obj=_KEEP, *, root: Optional[str] = None,
         for key, val in (("block_bytes", block_bytes),
                          ("coalesce", coalesce),
                          ("parallel", parallel),
-                         ("hydrate", hydrate)):
+                         ("hydrate", hydrate),
+                         ("codec_level", codec_level)):
             if val is not None:
                 _options[key] = val
         check(_options["block_bytes"] >= 1, "block_bytes must be >= 1")
@@ -166,6 +178,13 @@ class ObjectSeekStream(SeekStream):
         self._bb = int(opts["block_bytes"])
         self._coalesce = int(opts["coalesce"])
         self._parallel = int(opts["parallel"])
+        # page/wire codec: None falls back to the process default
+        # (DMLC_TPU_PAGE_CODEC_LEVEL); >0 requests transfer-encoded
+        # GETs (client permitting) and stores hydrated blocks encoded
+        from dmlc_tpu.io import codec as _codec_mod
+        lvl = opts.get("codec_level")
+        self._codec_level = (_codec_mod.default_level() if lvl is None
+                             else int(lvl))
         self._store = (store if store is not None
                        else (PageStore.default() if opts["hydrate"]
                              else None))
@@ -221,6 +240,7 @@ class ObjectSeekStream(SeekStream):
         return min(self.size, (ix + 1) * self._bb) - ix * self._bb
 
     def _block(self, ix: int) -> bytes:
+        from dmlc_tpu.io.codec import decode_page
         if ix == self._cur_ix:
             return self._cur
         data = None
@@ -229,6 +249,12 @@ class ObjectSeekStream(SeekStream):
             if s is not None:
                 with s:
                     data = s.read_all()
+                try:
+                    # hydrated entries may be codec-framed (the sidecar
+                    # stamps which); raw legacy pages pass through
+                    data = decode_page(data)
+                except DMLCError:
+                    data = b""  # corrupt frame: treat as torn below
                 if len(data) != self._expected(ix):
                     # torn/foreign page: refetch rather than serve it
                     self._store.delete(self._entry(ix))
@@ -289,31 +315,63 @@ class ObjectSeekStream(SeekStream):
         payload — injected truncation or a really-shrunk object — is
         detected against the requested range and raised as a transient
         IOError, so the site's retry policy re-fetches instead of the
-        caller parsing shifted bytes."""
-        want = end - start
+        caller parsing shifted bytes.
 
-        def attempt() -> bytes:
-            data = self._c.get(self._bucket, self._key, start, end)
-            data = _inject.corrupt("io.objstore.get", data)
+        With a codec level (and a client that speaks ``get_encoded``)
+        the range travels compressed: the decode runs INSIDE the retry
+        seam — a corrupt or truncated wire frame raises and the whole
+        GET re-fetches — and the counters stay honest:
+        ``objstore.bytes`` counts on-wire (compressed) bytes,
+        ``objstore.bytes_served`` the decompressed payload actually
+        handed downstream."""
+        from dmlc_tpu.io.codec import decode_page
+        want = end - start
+        encoded = (self._codec_level > 0
+                   and hasattr(self._c, "get_encoded"))
+
+        def attempt():
+            if encoded:
+                wire = self._c.get_encoded(self._bucket, self._key,
+                                           start, end,
+                                           self._codec_level)
+                wire = _inject.corrupt("io.objstore.get", wire)
+                try:
+                    data = decode_page(wire)
+                except DMLCError as e:
+                    raise IOError(
+                        f"objstore: corrupt encoded GET on "
+                        f"{self.path} [{start}, {end}): {e}") from e
+            else:
+                data = _inject.corrupt(
+                    "io.objstore.get",
+                    self._c.get(self._bucket, self._key, start, end))
+                wire = data
             if len(data) != want:
                 raise IOError(
                     f"objstore: short ranged GET on {self.path} "
                     f"[{start}, {end}): got {len(data)}/{want} bytes "
                     "(truncated object or torn transfer)")
-            return data
+            return wire, data
 
-        data = guarded("io.objstore.get", attempt)
+        wire, data = guarded("io.objstore.get", attempt)
         _count("get")
-        _count("bytes", len(data))
+        _count("bytes", len(wire))
+        _count("bytes_served", len(data))
         return data
 
     def _hydrate(self, ix: int, data: bytes) -> None:
         """Commit a fetched block into the page store (best-effort: a
-        full disk degrades to re-fetching, never kills the read)."""
+        full disk degrades to re-fetching, never kills the read). With
+        a codec level the entry is stored as a codec frame — fewer NVMe
+        bytes per cached block — and the sidecar stamps which codec
+        (``"codec"`` in the entry meta)."""
+        from dmlc_tpu.io.codec import encode_page, tag
         name = self._entry(ix)
+        data = encode_page(data, self._codec_level)
         try:
-            w = self._store.writer(name, fingerprint=self._fingerprint,
-                                   meta={"block": ix})
+            w = self._store.writer(
+                name, fingerprint=self._fingerprint,
+                meta={"block": ix, "codec": tag(self._codec_level)})
             try:
                 w.write(data)
             except Exception:

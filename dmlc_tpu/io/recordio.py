@@ -20,6 +20,17 @@ tests/test_io.py):
   (reference: src/io/recordio_split.cc SeekRecordBegin).
 - cflag: 0 = whole record in one frame; multi-frame records use
   1 (start), 2 (middle), 3 (end).
+
+Dense-record payload encoding (ABI 6, frozen — the native engine's
+``recordio_dense`` decoder and the Python golden
+``data/dense_record_parser.py`` both speak exactly this)::
+
+    u32 n_values (LE) | f32 label (LE) | f32[n_values] values (LE)
+
+A payload whose length is not exactly ``8 + 4 * n_values`` is corrupt
+and must raise DMLCError (the engine raises EngineError) — never a
+silently short row. ``DenseRecordWriter``/:func:`decode_dense_record`
+are the round-trip pair the parity tests pin.
 """
 
 from __future__ import annotations
@@ -27,12 +38,15 @@ from __future__ import annotations
 import struct
 from typing import Iterator, List, Optional, Tuple, Union
 
+import numpy as np
+
 from dmlc_tpu.io.stream import Stream
 from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = [
     "RECORDIO_MAGIC", "RecordIOWriter", "RecordIOReader",
     "RecordIOChunkReader", "encode_lrec", "decode_flag", "decode_length",
+    "DenseRecordWriter", "encode_dense_record", "decode_dense_record",
 ]
 
 RECORDIO_MAGIC = 0xced7230a
@@ -136,6 +150,55 @@ class IndexedRecordIOWriter(RecordIOWriter):
         self._index_stream.write(
             f"{key}\t{self._counter.written}\n".encode())
         super().write_record(data)
+
+
+_DENSE_HDR = struct.Struct("<If")  # n_values, label
+
+
+def encode_dense_record(label: float, values) -> bytes:
+    """One dense record payload: ``u32 n | f32 label | f32[n] values``
+    (all little-endian). ``values`` is any 1-D float sequence; the f32
+    cast here IS the stored precision (decode returns the exact
+    bits)."""
+    vals = np.ascontiguousarray(values, dtype="<f4")
+    check(vals.ndim == 1, "dense record: values must be 1-D")
+    return _DENSE_HDR.pack(len(vals), float(label)) + vals.tobytes()
+
+
+def decode_dense_record(payload) -> Tuple[np.float32, np.ndarray]:
+    """Decode one dense payload to ``(label, values)``. The length
+    contract is strict: a payload whose byte length disagrees with its
+    recorded ``n_values`` raises DMLCError (byte parity with the
+    engine's EngineError)."""
+    n_bytes = len(payload)
+    check(n_bytes >= _DENSE_HDR.size,
+          f"dense record: payload shorter than its 8-byte header "
+          f"({n_bytes} bytes)")
+    n, label = _DENSE_HDR.unpack_from(payload)
+    check(n_bytes == _DENSE_HDR.size + 4 * n,
+          f"dense record: n_values {n} disagrees with payload length "
+          f"{n_bytes}")
+    values = np.frombuffer(payload, dtype="<f4", count=n,
+                           offset=_DENSE_HDR.size)
+    return np.float32(label), values
+
+
+class DenseRecordWriter:
+    """RecordIO writer of dense records — the Python golden for the
+    engine's ABI-6 ``recordio_dense`` fast path. Magic-collision
+    escaping comes free from :class:`RecordIOWriter` (a value whose f32
+    bits equal the frame magic at a 4-aligned payload position becomes
+    a multi-frame record; the decoders stitch it back)."""
+
+    def __init__(self, stream: Stream):
+        self._w = RecordIOWriter(stream)
+
+    @property
+    def escaped_magic_count(self) -> int:
+        return self._w.escaped_magic_count
+
+    def write(self, label: float, values) -> None:
+        self._w.write_record(encode_dense_record(label, values))
 
 
 class RecordIOReader:

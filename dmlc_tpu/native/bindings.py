@@ -26,9 +26,9 @@ from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
            "NativeCSVParser", "NativeLibFMParser",
-           "NativeShardedTextParser", "NativeRecordIOReader",
-           "NativeIndexedRecordIOReader", "native_parse_float32",
-           "columns_interleave"]
+           "NativeDenseRecordParser", "NativeShardedTextParser",
+           "NativeRecordIOReader", "NativeIndexedRecordIOReader",
+           "native_parse_float32", "columns_interleave"]
 
 _lib = None
 
@@ -36,8 +36,11 @@ _lib = None
 # change (3: dtp_parser_create grew the `sparse` argument; 4: span-ring
 # trace surface; 5: native batch assembly — dtp_parser_next_padded /
 # dtp_padded_release / dtp_parser_start / dtp_parser_outstanding, and
-# dtp_parser_stats grew to 8 slots).
-ABI_VERSION = 5
+# dtp_parser_stats grew to 8 slots; 6: dense RecordIO decode —
+# dtp_parser_create accepts format "recordio_dense", the frozen
+# io/recordio.py dense payload contract decoded engine-side into the
+# same arena/NextPadded machinery).
+ABI_VERSION = 6
 
 
 def load(path: str):
@@ -89,6 +92,20 @@ def load(path: str):
         C.POINTER(C.c_int), C.POINTER(C.c_int), C.POINTER(C.c_int),
     ]
     lib.dtp_padded_release.argtypes = [C.c_void_p, C.c_void_p]
+    # ABI-6 gang assembly: padded batches cut ACROSS sharded
+    # sub-parsers (same out-param layout as dtp_parser_next_padded)
+    lib.dtp_gang_create.restype = C.c_void_p
+    lib.dtp_gang_create.argtypes = [C.POINTER(C.c_void_p), C.c_int64]
+    lib.dtp_gang_next_padded.restype = C.c_int64
+    lib.dtp_gang_next_padded.argtypes = \
+        list(lib.dtp_parser_next_padded.argtypes)
+    lib.dtp_gang_padded_release.argtypes = [C.c_void_p, C.c_void_p]
+    lib.dtp_gang_outstanding.restype = C.c_int64
+    lib.dtp_gang_outstanding.argtypes = [C.c_void_p]
+    lib.dtp_gang_assemble_ns.restype = C.c_int64
+    lib.dtp_gang_assemble_ns.argtypes = [C.c_void_p]
+    lib.dtp_gang_before_first.argtypes = [C.c_void_p]
+    lib.dtp_gang_destroy.argtypes = [C.c_void_p]
     lib.dtp_parser_start.argtypes = [C.c_void_p]
     lib.dtp_parser_outstanding.restype = C.c_int64
     lib.dtp_parser_outstanding.argtypes = [C.c_void_p]
@@ -613,6 +630,16 @@ class NativeTextParser(Parser):
             pass
 
 
+class _GangPaddedLease(BlockLease):
+    """Lease over one gang-assembled padded block (ABI 6): the buffers
+    return to the GANG's padded pool on release (the owner's _handle
+    is the gang handle, not a parser handle)."""
+
+    __slots__ = ()
+
+    _release_fn = "dtp_gang_padded_release"
+
+
 class _RecioLease(BlockLease):
     """BlockLease for record batches (different C release entry)."""
 
@@ -873,8 +900,32 @@ class NativeLibFMParser(NativeTextParser):
     _format = "libfm"
 
 
+class NativeDenseRecordParser(NativeTextParser):
+    """Dense RecordIO decode over the native pipeline (ABI 6): the
+    engine's RecordIOShardReader realigns the shard by magic scan and
+    the parse pool decodes each record's frozen dense payload
+    (``u32 n_values | f32 label | f32[n] values`` — io/recordio.py)
+    straight into CSR arenas: indices are the column ordinals, values a
+    memcpy of the payload's f32 bits. Byte-identical to the Python
+    golden (data/dense_record_parser.py); ``next_padded`` feeds the
+    same ABI-5 device-layout lease path as the text formats, so
+    ``batch(pad=True)`` runs pad+stack in C with the GIL released."""
+
+    _format = "recordio_dense"
+
+    def _configure(self, kwargs):
+        # the recordio framing is the format; only the standard engine
+        # knobs apply (no delimiter/label_column/sparse semantics)
+        split_type = kwargs.pop("split_type", "recordio")
+        if split_type != "recordio":
+            return (f"recordio_dense: split_type must be 'recordio', "
+                    f"got {split_type!r}")
+        return super()._configure(kwargs)
+
+
 _SHARDED_FORMATS = {"libsvm": NativeLibSVMParser, "csv": NativeCSVParser,
-                    "libfm": NativeLibFMParser}
+                    "libfm": NativeLibFMParser,
+                    "recordio_dense": NativeDenseRecordParser}
 
 
 class NativeShardedTextParser(Parser):
@@ -892,6 +943,16 @@ class NativeShardedTextParser(Parser):
     reorder window holds its early blocks, so all shards read and parse
     concurrently while the emitted stream stays BYTE-IDENTICAL to the
     1-parser stream (pinned by tests/test_native.py).
+
+    ``next_padded`` (ABI 6) assembles device-layout batches ACROSS the
+    shards in C (``dtp_gang_next_padded``): the gang handle drains the
+    sub-parsers' arena streams in shard order through the same padded
+    emission a single parser uses, so batches are cut across shard
+    boundaries exactly as the 1-parser stream cuts them — byte
+    parity pinned — and the sharded steady path keeps Python off the
+    row bytes (pre-6, sharded parses paid the Python fused pad, which
+    BOUND memcpy-cheap formats like recordio_dense below the unsharded
+    native path).
 
     Serves the whole input only (part 0 of 1): nesting an outer
     part/num_parts split and the inner shard split would apply the
@@ -923,6 +984,25 @@ class NativeShardedTextParser(Parser):
         self._started = False
         self._block: Optional[RowBlock] = None
         self._block_sub: Optional[NativeTextParser] = None
+        # ABI-6 gang handle: padded assembly across the sub-parsers
+        # (borrows their handles — destroyed BEFORE the subs)
+        self._lib = self._subs[0]._lib
+        ptrs = (C.c_void_p * self.shards)(
+            *[p._handle for p in self._subs])
+        self._handle = self._lib.dtp_gang_create(ptrs, self.shards)
+        if not self._handle:
+            raise DMLCError(
+                f"gang create failed: {self._lib.dtp_last_error().decode()}")
+        self._please: Optional[_GangPaddedLease] = None
+        self._mode: Optional[str] = None  # "blocks" | "padded" per epoch
+        # padded out-params, allocate-once (NativeTextParser discipline)
+        self._p = (C.c_void_p(), C.POINTER(C.c_int64)(),
+                   C.POINTER(C.c_float)(), C.POINTER(C.c_float)(),
+                   C.POINTER(C.c_float)(), C.POINTER(C.c_uint32)(),
+                   C.POINTER(C.c_uint64)(), C.POINTER(C.c_int64)(),
+                   C.POINTER(C.c_int64)(), C.c_int64(),
+                   C.c_int(), C.c_int(), C.c_int())
+        self._prefs = tuple(C.byref(x) for x in self._p)
 
     def _start_all(self) -> None:
         for p in self._subs:
@@ -930,9 +1010,17 @@ class NativeShardedTextParser(Parser):
         self._started = True
 
     def before_first(self) -> None:
+        if self._please is not None:
+            self._please.release()
+            self._please = None
+        # after destroy() the gang handle is gone: stay the safe no-op
+        # the pre-gang code was (subs are empty too)
+        if getattr(self, "_handle", None):
+            self._lib.dtp_gang_before_first(self._handle)
         for p in self._subs:
             p.before_first()
         self._cur = 0
+        self._mode = None
         self._block = None
         self._block_sub = None
         # restart every sub-pipeline NOW: shard j's reader/workers fill
@@ -940,6 +1028,12 @@ class NativeShardedTextParser(Parser):
         self._start_all()
 
     def next(self) -> bool:
+        if self._mode == "padded":
+            raise DMLCError(
+                "sharded parser: next() after next_padded() within one "
+                "epoch — rows already cut into the gang's padded carry "
+                "would be skipped; call before_first() first")
+        self._mode = "blocks"
         if not self._started:
             self._start_all()
         while self._cur < len(self._subs):
@@ -957,18 +1051,87 @@ class NativeShardedTextParser(Parser):
         check(self._block is not None, "value() before successful next()")
         return self._block
 
+    def next_padded(self, rows: int, row_bucket: Optional[int] = None,
+                    nnz_bucket: int = 0, want_qid: bool = False,
+                    want_field: bool = False
+                    ) -> Optional[Dict[str, np.ndarray]]:
+        """One bucket-padded batch assembled across the shards in the
+        ENGINE (ABI 6, dtp_gang_next_padded): same layout contract,
+        lease discipline, and Python-golden byte parity as
+        NativeTextParser.next_padded — the gang cuts batches over the
+        shard-ordered arena stream, so output is identical to the
+        1-parser padded stream."""
+        if self._mode == "blocks":
+            raise DMLCError(
+                "sharded parser: next_padded() after next() within one "
+                "epoch — the gang's padded carry would skip the leased "
+                "block's rows; call before_first() first")
+        self._mode = "padded"
+        if not self._started:
+            self._start_all()
+        if self._please is not None:
+            self._please.release()
+            self._please = None
+        rb = rows if row_bucket is None else row_bucket
+        n = self._lib.dtp_gang_next_padded(
+            self._handle, rows, rb, nnz_bucket,
+            1 if want_qid else 0, 1 if want_field else 0, *self._prefs)
+        (block, offset, label, weight, value, index32, index64, qid,
+         field, num_nnz, wide, has_qid, has_field) = self._p
+        if n < 0:
+            raise DMLCError(
+                f"sharded: {self._lib.dtp_last_error().decode()}")
+        if n == 0:
+            return None
+        z = int(num_nnz.value)
+        lease = _GangPaddedLease(self, block.value)
+
+        def arr(ptr, count, dtype):
+            if count == 0:
+                return np.empty(0, dtype)
+            return np.ctypeslib.as_array(ptr, shape=(count,))
+
+        nb = int(nnz_bucket)
+        if wide.value:
+            index = arr(index64, nb, np.uint64)
+        else:
+            index = arr(index32, nb, np.uint32)
+        if self.index_dtype != index.dtype:
+            index = index.astype(self.index_dtype)
+        out = PaddedBatch(
+            {"offset": arr(offset, rb + 1, np.int64),
+             "label": arr(label, rb, np.float32),
+             "weight": arr(weight, rb, np.float32),
+             "index": index,
+             "value": arr(value, nb, np.float32),
+             "num_rows": np.int32(n), "num_nnz": np.int32(z)})
+        if has_qid.value:
+            out["qid"] = arr(qid, rb, np.int64)
+        if has_field.value:
+            out["field"] = arr(field, nb, np.int64)
+        self._please = lease
+        return out
+
     def detach(self) -> Optional[BlockLease]:
+        if self._please is not None:
+            lease, self._please = self._please, None
+            return lease
         return (self._block_sub.detach()
                 if self._block_sub is not None else None)
 
     def stats(self) -> Dict[str, int]:
         """Summed busy/cpu/chunk/assemble counters over the sub-parsers
         (they run concurrently, so summed busy vs the max wall proves
-        the cross-shard overlap); depths are maxima."""
+        the cross-shard overlap); depths are maxima. The gang's own
+        padded-assembly copy time joins assemble_ns (sub-parsers report
+        0 there on the gang path — their planes never run)."""
         outs = [p.stats() for p in self._subs]
         agg = {k: sum(o[k] for o in outs)
                for k in ("reader_busy_ns", "parse_busy_ns", "chunks",
                          "parse_cpu_ns", "assemble_ns")}
+        if getattr(self, "_handle", None):
+            agg["assemble_ns"] += int(
+                self._lib.dtp_gang_assemble_ns(self._handle))
         agg["wall_ns"] = max(o["wall_ns"] for o in outs)
         agg["max_chunk_queue_depth"] = max(
             o["max_chunk_queue_depth"] for o in outs)
@@ -984,12 +1147,21 @@ class NativeShardedTextParser(Parser):
         return sum(p.drain_trace(rec) for p in self._subs)
 
     def outstanding(self) -> int:
-        return sum(p.outstanding() for p in self._subs)
+        gang = (int(self._lib.dtp_gang_outstanding(self._handle))
+                if getattr(self, "_handle", None) else 0)
+        return gang + sum(p.outstanding() for p in self._subs)
 
     def bytes_read(self) -> int:
         return sum(p.bytes_read() for p in self._subs)
 
     def destroy(self) -> None:
+        if getattr(self, "_handle", None):
+            if self._please is not None:
+                self._please.release()
+                self._please = None
+            # the gang borrows the sub handles: destroy it FIRST
+            self._lib.dtp_gang_destroy(self._handle)
+            self._handle = None
         for p in self._subs:
             p.destroy()
         self._subs = []

@@ -1178,7 +1178,7 @@ void DecodeRecordIOChunkInPlace(RecBatch* out) {
 
 // ----------------------------------------------------------- format parse
 
-enum class Format { kLibSVM, kCSV, kLibFM };
+enum class Format { kLibSVM, kCSV, kLibFM, kRecIODense };
 
 struct ParserConfig {
   Format format = Format::kLibSVM;
@@ -1911,6 +1911,113 @@ void ParseLibFMSlice(const char* b, const char* e, CSRArena* a) {
   AuditCursorBounds(*a);
 }
 
+// ------------------------------------------------ dense recordio decode
+// ABI-6 fast path for the frozen dense payload encoding
+// (io/recordio.py: u32 n_values LE | f32 label LE | f32[n] values LE)
+// inside standard RecordIO framing. Each record becomes one CSR row:
+// indices are the column ordinals 0..n-1, values are the payload's
+// exact f32 bits (a memcpy, no float parsing at all) — so the decode
+// is byte-identical to the Python golden by construction and the block
+// feeds the same arena/NextPadded machinery as the text formats.
+//
+// The chunk may be a READ-ONLY mmap view, so multi-frame
+// (escaped-magic) records stitch into a small scratch string instead
+// of in place (rare: only payloads carrying the frame magic at a
+// 4-aligned position ever split).
+void ParseRecIODenseSlice(const char* d, size_t n, CSRArena* a) {
+  // worst-case bounds reserved once → raw cursor writes (the text
+  // kernels' pattern): a whole record frame is >= 16 bytes (8-byte
+  // frame header + 8-byte payload header), and the value payload can
+  // never exceed the chunk's own bytes
+  a->index32.reserve(a->index32.size() + n / 4 + 1);
+  a->value.reserve(a->value.size() + n / 4 + 1);
+  a->label.reserve(a->label.size() + n / 16 + 2);
+  a->offset.reserve(a->offset.size() + n / 16 + 2);
+  uint32_t* ic = a->index32.data() + a->index32.size();
+  float* vc = a->value.data() + a->value.size();
+  float* lc = a->label.data() + a->label.size();
+  int64_t* oc = a->offset.data() + a->offset.size();
+  int64_t off = oc[-1];  // arena invariant: offset always starts {0}
+  const RowBounds bounds(*a);
+  uint64_t max_n = 0;
+  std::string scratch;  // multi-frame stitch target (rare)
+  auto emit = [&](const char* p, size_t len) {
+    if (len < 8)
+      throw EngineError{
+          "recordio_dense: record payload shorter than its 8-byte "
+          "header (" + std::to_string(len) + " bytes)"};
+    uint32_t nv = load_u32le(p);
+    if ((uint64_t)len != 8ull + 4ull * nv)
+      throw EngineError{"recordio_dense: n_values " +
+                        std::to_string(nv) +
+                        " disagrees with payload length " +
+                        std::to_string(len)};
+    // pre-write bounds: a violated reserve invariant is caught BEFORE
+    // the memcpy, not a slice later
+    bounds.check(ic + nv, vc + nv, lc, oc);
+    float label;
+    std::memcpy(&label, p + 4, 4);
+    std::memcpy(vc, p + 8, (size_t)nv * 4);
+    for (uint32_t k = 0; k < nv; ++k) ic[k] = k;
+    ic += nv;
+    vc += nv;
+    *lc++ = label;
+    off += (int64_t)nv;
+    *oc++ = off;
+    if (nv > max_n) max_n = nv;
+  };
+  size_t pos = 0;
+  bool in_multi = false;
+  while (pos < n) {
+    if (pos + 8 > n)
+      throw EngineError{"recordio_dense: truncated frame header"};
+    if (load_u32le(d + pos) != kRecIOMagic)
+      throw EngineError{"recordio_dense: invalid magic"};
+    uint32_t lrec = load_u32le(d + pos + 4);
+    uint32_t cflag = (lrec >> 29) & 7;
+    size_t clen = lrec & ((1u << 29) - 1);
+    size_t start = pos + 8;
+    if (start + clen > n)
+      throw EngineError{"recordio_dense: truncated frame payload"};
+    if (in_multi && (cflag == 0 || cflag == 1))
+      throw EngineError{
+          "recordio_dense: new record inside multi-frame record"};
+    if (!in_multi && cflag >= 2)
+      throw EngineError{
+          "recordio_dense: continuation frame without start"};
+    switch (cflag) {
+      case 0:
+        emit(d + start, clen);
+        break;
+      case 1:
+        scratch.assign(d + start, clen);
+        in_multi = true;
+        break;
+      default:  // 2 middle / >=3 end: re-insert the escaped magic
+        scratch.append((const char*)&kRecIOMagic, 4);
+        scratch.append(d + start, clen);
+        if (cflag >= 3) {
+          emit(scratch.data(), scratch.size());
+          in_multi = false;
+        }
+        break;
+    }
+    pos = start + clen + ((4 - (clen & 3)) & 3);
+  }
+  if (in_multi)
+    throw EngineError{"recordio_dense: truncated multi-frame record"};
+  a->label.n = (size_t)(lc - a->label.data());
+  a->offset.n = (size_t)(oc - a->offset.data());
+  a->index32.n = (size_t)(ic - a->index32.data());  // dense never widens
+  a->value.n = (size_t)(vc - a->value.data());
+  // index range is structural (every row indexes 0..n-1): no rescan
+  if (max_n > 0) {
+    a->min_index = 0;
+    a->max_index = max_n - 1;
+  }
+  AuditCursorBounds(*a);
+}
+
 // Parse one whole chunk into one arena on the calling worker thread.
 // Parallelism is chunk-granular (each pool worker owns a whole chunk),
 // so there is no slice stitch and no cross-thread append copy at all —
@@ -1932,6 +2039,10 @@ void ParseChunkInto(const char* b, size_t len, const ParserConfig& cfg,
     case Format::kLibFM:
       ParseLibFMSlice(b, e, out);
       break;
+    case Format::kRecIODense:
+      // dense decode sets its index range structurally during parse
+      ParseRecIODenseSlice(b, len, out);
+      return;
   }
   if (cfg.format != Format::kCSV) out->compute_index_range();
 }
@@ -2263,9 +2374,300 @@ struct PaddedBlock {
   bool wide = false, has_qid = false, has_field = false;
 };
 
+// The padded-emission state, factored out of ParserHandle (ABI 6) so
+// ONE implementation serves both a single parser and a GANG of
+// sharded sub-parsers: pooled padded blocks, the outstanding-lease
+// map, and the carry (the arena currently being cut, carry_row rows
+// already copied out; recycled to its ORIGIN handle the moment its
+// last row lands in a padded buffer).
+struct PaddedPlane {
+  std::mutex mu;
+  std::vector<std::unique_ptr<PaddedBlock>> pool;
+  std::map<PaddedBlock*, std::unique_ptr<PaddedBlock>> outstanding;
+  std::unique_ptr<CSRArena> carry;
+  void* carry_origin = nullptr;  // opaque arena origin (recycle target)
+  size_t carry_row = 0;
+  bool eof = false;
+
+  std::unique_ptr<PaddedBlock> Get() {
+    std::lock_guard<std::mutex> lk(mu);
+    if (!pool.empty()) {
+      auto b = std::move(pool.back());
+      pool.pop_back();
+      return b;
+    }
+    return std::make_unique<PaddedBlock>();
+  }
+
+  void PutBack(std::unique_ptr<PaddedBlock> b) {
+    std::lock_guard<std::mutex> lk(mu);
+    pool.push_back(std::move(b));
+  }
+
+  PaddedBlock* Lease(std::unique_ptr<PaddedBlock> b) {
+    PaddedBlock* raw = b.get();
+    std::lock_guard<std::mutex> lk(mu);
+    outstanding[raw] = std::move(b);
+    return raw;
+  }
+
+  void Release(PaddedBlock* b) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = outstanding.find(b);
+    if (it == outstanding.end()) return;
+    pool.push_back(std::move(it->second));
+    outstanding.erase(it);
+  }
+
+  size_t OutstandingCount() {
+    std::lock_guard<std::mutex> lk(mu);
+    return outstanding.size();
+  }
+
+  void TrimPool() {
+    std::vector<std::unique_ptr<PaddedBlock>> drop;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      drop.swap(pool);
+    }  // destructors outside mu (BlockCache::Put takes its own lock)
+  }
+
+  // epoch reset: the partially consumed carry goes back to its origin;
+  // leased padded blocks stay valid until released (the CSR-lease
+  // contract). `recycle(arena, origin)` is the caller's recycler.
+  template <typename RecycleFn>
+  void Reset(RecycleFn recycle) {
+    std::unique_ptr<CSRArena> c;
+    void* origin = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      c = std::move(carry);
+      origin = carry_origin;
+      carry_origin = nullptr;
+      carry_row = 0;
+      eof = false;
+    }
+    if (c) recycle(std::move(c), origin);
+  }
+};
+
+// Assemble ONE bucket-padded, device-layout batch of up to
+// rows_per_batch rows (short only at end of stream) — the ABI-5/6
+// padded emission shared by dtp_parser_next_padded (one parser) and
+// dtp_gang_next_padded (a sharded gang cutting batches ACROSS its
+// sub-parsers' arena streams, so the batch layout is identical to the
+// 1-parser stream). Matches the Python fused golden
+// (data/padding.py stack_padded_rows over a RowBlockContainer batch)
+// byte for byte: offset rebased per batch with the pad tail repeating
+// num_nnz, label/weight pad 0 (absent weights fill 1), index/value/
+// field pad 0, qid fill/pad -1; qid key emitted iff some row's
+// qid != -1 (or want_qid), field key iff some constituent arena
+// carried fields (or want_field). Returns rows (>0), 0 at end,
+// -1 error (message in *error).
+//
+// next_arena(out, origin) pulls the next non-empty arena (>0 rows,
+// 0 end, -1 error) recording where it came from; recycle(arena,
+// origin) returns a fully-cut arena to that origin's free list — the
+// consumer never holds arena bytes on this path.
+template <typename NextArenaFn, typename RecycleFn>
+int64_t NextPaddedImpl(PaddedPlane& P, NextArenaFn next_arena,
+                       RecycleFn recycle, PipelineStats* stats,
+                       SpanRing* ring, std::string* error,
+                       int64_t rows_per_batch, int64_t row_bucket,
+                       int64_t nnz_bucket, bool want_qid,
+                       bool want_field, PaddedBlock** out) {
+  if (rows_per_batch < 1 || row_bucket < rows_per_batch ||
+      nnz_bucket < 0) {
+    *error = "padded batch: need 1 <= rows_per_batch <= row_bucket";
+    return -1;
+  }
+  auto pb = P.Get();
+  auto recycle_pb = [&] { P.PutBack(std::move(pb)); };
+  // pooled buffers: clear n BEFORE reserve so a regrow never pays a
+  // copy of stale contents; n is then set to the bucket size and all
+  // writes go through raw data() cursors
+  auto prep = [](auto& buf, size_t count) {
+    buf.clear();
+    buf.reserve(count);
+    buf.n = count;
+  };
+  prep(pb->offset, (size_t)row_bucket + 1);
+  prep(pb->label, (size_t)row_bucket);
+  prep(pb->weight, (size_t)row_bucket);
+  prep(pb->value, (size_t)nnz_bucket);
+  prep(pb->index32, (size_t)nnz_bucket);
+  pb->index64.clear();
+  pb->qid.clear();
+  pb->field.clear();
+  pb->wide = false;
+  int64_t r = 0, z = 0;
+  bool any_qid = false, any_field = false;
+  bool qid_filled = false, field_filled = false;
+  int64_t t_first = 0, batch_ns = 0;
+  pb->offset.data()[0] = 0;
+  while (r < rows_per_batch) {
+    if (!P.carry) {
+      if (P.eof) break;
+      int64_t rows = next_arena(&P.carry, &P.carry_origin);
+      if (rows < 0) {
+        recycle_pb();
+        return -1;
+      }
+      if (rows == 0) {
+        P.eof = true;
+        break;
+      }
+      P.carry_row = 0;
+    }
+    int64_t t0 = now_ns();
+    if (!t_first) t_first = t0;
+    CSRArena* a = P.carry.get();
+    size_t take = std::min((size_t)(rows_per_batch - r),
+                           a->rows() - P.carry_row);
+    int64_t a_lo = a->offset[P.carry_row];
+    int64_t slice_nnz = a->offset[P.carry_row + take] - a_lo;
+    if (z + slice_nnz > nnz_bucket) {
+      *error = "padded batch: nnz " + std::to_string(z + slice_nnz) +
+               " exceeds nnz_bucket " + std::to_string(nnz_bucket) +
+               " (nnz bucket too small)";
+      recycle_pb();
+      return -1;
+    }
+    // offset: rebase the slice by a constant delta
+    {
+      int64_t delta = z - a_lo;
+      int64_t* po = pb->offset.data() + r + 1;
+      const int64_t* so = a->offset.data() + P.carry_row + 1;
+      for (size_t k = 0; k < take; ++k) po[k] = so[k] + delta;
+    }
+    std::memcpy(pb->label.data() + r, a->label.data() + P.carry_row,
+                take * sizeof(float));
+    if (a->has_weight)
+      std::memcpy(pb->weight.data() + r, a->weight.data() + P.carry_row,
+                  take * sizeof(float));
+    else
+      std::fill(pb->weight.data() + r, pb->weight.data() + r + take,
+                1.0f);
+    if (a->has_qid || qid_filled || want_qid) {
+      if (!qid_filled) {
+        prep(pb->qid, (size_t)row_bucket);
+        std::fill(pb->qid.data(), pb->qid.data() + r, (int64_t)-1);
+        qid_filled = true;
+      }
+      int64_t* pq = pb->qid.data() + r;
+      if (a->has_qid) {
+        const int64_t* sq = a->qid.data() + P.carry_row;
+        for (size_t k = 0; k < take; ++k) {
+          pq[k] = sq[k];
+          any_qid |= sq[k] != -1;
+        }
+      } else {
+        std::fill(pq, pq + take, (int64_t)-1);
+      }
+    }
+    if (a->has_field || field_filled || want_field) {
+      if (!field_filled) {
+        prep(pb->field, (size_t)nnz_bucket);
+        std::fill(pb->field.data(), pb->field.data() + z, (int64_t)0);
+        field_filled = true;
+      }
+      int64_t* pf = pb->field.data() + z;
+      if (a->has_field) {
+        std::memcpy(pf, a->field.data() + a_lo,
+                    (size_t)slice_nnz * sizeof(int64_t));
+        any_field = true;
+      } else {
+        std::fill(pf, pf + slice_nnz, (int64_t)0);
+      }
+    }
+    if (a->wide) {
+      if (!pb->wide) {
+        prep(pb->index64, (size_t)nnz_bucket);
+        const uint32_t* s32 = pb->index32.data();
+        uint64_t* d64 = pb->index64.data();
+        for (int64_t k = 0; k < z; ++k) d64[k] = s32[k];
+        pb->wide = true;
+      }
+      std::memcpy(pb->index64.data() + z, a->index64.data() + a_lo,
+                  (size_t)slice_nnz * sizeof(uint64_t));
+    } else if (pb->wide) {
+      const uint32_t* s32 = a->index32.data() + a_lo;
+      uint64_t* d64 = pb->index64.data() + z;
+      for (int64_t k = 0; k < slice_nnz; ++k) d64[k] = s32[k];
+    } else {
+      std::memcpy(pb->index32.data() + z, a->index32.data() + a_lo,
+                  (size_t)slice_nnz * sizeof(uint32_t));
+    }
+    std::memcpy(pb->value.data() + z, a->value.data() + a_lo,
+                (size_t)slice_nnz * sizeof(float));
+    r += (int64_t)take;
+    z += slice_nnz;
+    P.carry_row += take;
+    if (P.carry_row == a->rows()) {
+      // the whole arena is in padded buffers: its bytes return to
+      // the ORIGIN's free list NOW, not when the consumer finishes
+      recycle(std::move(P.carry), P.carry_origin);
+      P.carry_row = 0;
+    }
+    batch_ns += now_ns() - t0;
+  }
+  if (r == 0) {
+    recycle_pb();
+    return 0;  // clean end of stream
+  }
+  int64_t t0 = now_ns();
+  if (!t_first) t_first = t0;
+  // neutral pad tails — the exact values the Python fused path writes
+  std::fill(pb->offset.data() + r + 1,
+            pb->offset.data() + row_bucket + 1, z);
+  std::fill(pb->label.data() + r, pb->label.data() + row_bucket, 0.0f);
+  std::fill(pb->weight.data() + r, pb->weight.data() + row_bucket,
+            0.0f);
+  pb->has_qid = want_qid || any_qid;
+  if (pb->has_qid) {
+    if (!qid_filled) {
+      prep(pb->qid, (size_t)row_bucket);
+      std::fill(pb->qid.data(), pb->qid.data() + r, (int64_t)-1);
+    }
+    std::fill(pb->qid.data() + r, pb->qid.data() + row_bucket,
+              (int64_t)-1);
+  }
+  pb->has_field = want_field || any_field;
+  if (pb->has_field) {
+    if (!field_filled) {
+      prep(pb->field, (size_t)nnz_bucket);
+      std::fill(pb->field.data(), pb->field.data() + z, (int64_t)0);
+    }
+    std::fill(pb->field.data() + z, pb->field.data() + nnz_bucket,
+              (int64_t)0);
+  }
+  if (pb->wide)
+    std::fill(pb->index64.data() + z, pb->index64.data() + nnz_bucket,
+              (uint64_t)0);
+  else
+    std::fill(pb->index32.data() + z, pb->index32.data() + nnz_bucket,
+              (uint32_t)0);
+  std::fill(pb->value.data() + z, pb->value.data() + nnz_bucket, 0.0f);
+  pb->num_rows = r;
+  pb->num_nnz = z;
+  batch_ns += now_ns() - t0;
+  stats->assemble_ns += batch_ns;
+  if (ring && trace_on())
+    // one assemble span per padded batch, anchored at its first copy;
+    // duration is copy time only (queue waits between slices already
+    // ride on the Python pull span)
+    ring->Record(kTraceBatchAssemble, kTidConsumer, t_first, batch_ns,
+                 r);
+  *out = P.Lease(std::move(pb));
+  return r;
+}
+
 struct ParserHandle {
   ParserConfig cfg;
-  std::unique_ptr<TextShardReader> reader;
+  // text formats read through TextShardReader, recordio_dense through
+  // RecordIOShardReader — the pipeline (reader thread, chunk queue,
+  // parse pool, ordered reorder window, padded emission) is identical
+  std::unique_ptr<ShardReaderBase> reader;
   int nthreads = 1;
   int test_delay_ms = 0;  // test hook: per-chunk parse delay (scaling proof)
   // test hook: FNV-1a checksum over every chunk byte, N rounds, before
@@ -2298,15 +2700,11 @@ struct ParserHandle {
   // at the ABI; bindings release the previous block on the next next())
   std::map<CSRArena*, std::unique_ptr<CSRArena>> outstanding;
 
-  // ABI-5 padded emission state. carry = the arena currently being cut
-  // into padded batches (carry_row rows of it already copied out);
-  // recycled to arena_pool the moment its last row lands in a padded
-  // buffer — the consumer never holds an arena on the padded path.
-  std::unique_ptr<CSRArena> carry;
-  size_t carry_row = 0;
-  bool padded_eof = false;
-  std::vector<std::unique_ptr<PaddedBlock>> padded_pool;
-  std::map<PaddedBlock*, std::unique_ptr<PaddedBlock>> outstanding_padded;
+  // ABI-5/6 padded emission state (PaddedPlane: pooled padded blocks,
+  // outstanding leases, and the carry arena being cut — recycled to
+  // arena_pool the moment its last row lands in a padded buffer, so
+  // the consumer never holds an arena on the padded path).
+  PaddedPlane plane;
   int64_t last_pop_ns = 0;  // trace anchor: set after a successful pop
 
   std::unique_ptr<CSRArena> GetArena() {
@@ -2538,235 +2936,35 @@ struct ParserHandle {
     return rows;
   }
 
-  // ---- ABI-5 padded emission (see PaddedBlock above) ----
+  // ---- ABI-5/6 padded emission (PaddedPlane + NextPaddedImpl) ----
 
-  std::unique_ptr<PaddedBlock> GetPadded() {
-    std::lock_guard<std::mutex> lk(pool_mu);
-    if (!padded_pool.empty()) {
-      auto b = std::move(padded_pool.back());
-      padded_pool.pop_back();
-      return b;
-    }
-    return std::make_unique<PaddedBlock>();
-  }
-
-  void ReleasePadded(PaddedBlock* b) {
-    std::lock_guard<std::mutex> lk(pool_mu);
-    auto it = outstanding_padded.find(b);
-    if (it == outstanding_padded.end()) return;
-    padded_pool.push_back(std::move(it->second));
-    outstanding_padded.erase(it);
-  }
+  void ReleasePadded(PaddedBlock* b) { plane.Release(b); }
 
   size_t OutstandingCount() {
-    std::lock_guard<std::mutex> lk(pool_mu);
-    return outstanding.size() + outstanding_padded.size();
+    size_t csr;
+    {
+      std::lock_guard<std::mutex> lk(pool_mu);
+      csr = outstanding.size();
+    }
+    return csr + plane.OutstandingCount();
   }
 
-  // Assemble ONE bucket-padded, device-layout batch of up to
-  // rows_per_batch rows (short only at end of stream). Matches the
-  // Python fused golden (data/padding.py stack_padded_rows over a
-  // RowBlockContainer batch) byte for byte: offset rebased per batch
-  // with the pad tail repeating num_nnz, label/weight pad 0 (absent
-  // weights fill 1), index/value/field pad 0, qid fill/pad -1; qid key
-  // emitted iff some row's qid != -1 (or want_qid), field key iff some
-  // constituent arena carried fields (or want_field). Returns rows
-  // (>0), 0 at end, -1 error.
+  // One padded batch via the shared NextPaddedImpl: this handle's
+  // arena stream is the source, arenas recycle to this handle's own
+  // free list. Returns rows (>0), 0 at end, -1 error (this->error).
   int64_t NextPadded(int64_t rows_per_batch, int64_t row_bucket,
                      int64_t nnz_bucket, bool want_qid, bool want_field,
                      PaddedBlock** out) {
-    if (rows_per_batch < 1 || row_bucket < rows_per_batch ||
-        nnz_bucket < 0) {
-      error = "padded batch: need 1 <= rows_per_batch <= row_bucket";
-      return -1;
-    }
-    auto pb = GetPadded();
-    auto recycle_pb = [&] {
-      std::lock_guard<std::mutex> lk(pool_mu);
-      padded_pool.push_back(std::move(pb));
+    auto next = [this](std::unique_ptr<CSRArena>* a, void** origin) {
+      *origin = this;
+      return NextArena(a);
     };
-    // pooled buffers: clear n BEFORE reserve so a regrow never pays a
-    // copy of stale contents; n is then set to the bucket size and all
-    // writes go through raw data() cursors
-    auto prep = [](auto& buf, size_t count) {
-      buf.clear();
-      buf.reserve(count);
-      buf.n = count;
+    auto recycle = [](std::unique_ptr<CSRArena> a, void* origin) {
+      static_cast<ParserHandle*>(origin)->RecycleArena(std::move(a));
     };
-    prep(pb->offset, (size_t)row_bucket + 1);
-    prep(pb->label, (size_t)row_bucket);
-    prep(pb->weight, (size_t)row_bucket);
-    prep(pb->value, (size_t)nnz_bucket);
-    prep(pb->index32, (size_t)nnz_bucket);
-    pb->index64.clear();
-    pb->qid.clear();
-    pb->field.clear();
-    pb->wide = false;
-    int64_t r = 0, z = 0;
-    bool any_qid = false, any_field = false;
-    bool qid_filled = false, field_filled = false;
-    int64_t t_first = 0, batch_ns = 0;
-    pb->offset.data()[0] = 0;
-    while (r < rows_per_batch) {
-      if (!carry) {
-        if (padded_eof) break;
-        int64_t rows = NextArena(&carry);
-        if (rows < 0) {
-          recycle_pb();
-          return -1;
-        }
-        if (rows == 0) {
-          padded_eof = true;
-          break;
-        }
-        carry_row = 0;
-      }
-      int64_t t0 = now_ns();
-      if (!t_first) t_first = t0;
-      CSRArena* a = carry.get();
-      size_t take = std::min((size_t)(rows_per_batch - r),
-                             a->rows() - carry_row);
-      int64_t a_lo = a->offset[carry_row];
-      int64_t slice_nnz = a->offset[carry_row + take] - a_lo;
-      if (z + slice_nnz > nnz_bucket) {
-        error = "padded batch: nnz " + std::to_string(z + slice_nnz) +
-                " exceeds nnz_bucket " + std::to_string(nnz_bucket) +
-                " (nnz bucket too small)";
-        recycle_pb();
-        return -1;
-      }
-      // offset: rebase the slice by a constant delta
-      {
-        int64_t delta = z - a_lo;
-        int64_t* po = pb->offset.data() + r + 1;
-        const int64_t* so = a->offset.data() + carry_row + 1;
-        for (size_t k = 0; k < take; ++k) po[k] = so[k] + delta;
-      }
-      std::memcpy(pb->label.data() + r, a->label.data() + carry_row,
-                  take * sizeof(float));
-      if (a->has_weight)
-        std::memcpy(pb->weight.data() + r, a->weight.data() + carry_row,
-                    take * sizeof(float));
-      else
-        std::fill(pb->weight.data() + r, pb->weight.data() + r + take,
-                  1.0f);
-      if (a->has_qid || qid_filled || want_qid) {
-        if (!qid_filled) {
-          prep(pb->qid, (size_t)row_bucket);
-          std::fill(pb->qid.data(), pb->qid.data() + r, (int64_t)-1);
-          qid_filled = true;
-        }
-        int64_t* pq = pb->qid.data() + r;
-        if (a->has_qid) {
-          const int64_t* sq = a->qid.data() + carry_row;
-          for (size_t k = 0; k < take; ++k) {
-            pq[k] = sq[k];
-            any_qid |= sq[k] != -1;
-          }
-        } else {
-          std::fill(pq, pq + take, (int64_t)-1);
-        }
-      }
-      if (a->has_field || field_filled || want_field) {
-        if (!field_filled) {
-          prep(pb->field, (size_t)nnz_bucket);
-          std::fill(pb->field.data(), pb->field.data() + z, (int64_t)0);
-          field_filled = true;
-        }
-        int64_t* pf = pb->field.data() + z;
-        if (a->has_field) {
-          std::memcpy(pf, a->field.data() + a_lo,
-                      (size_t)slice_nnz * sizeof(int64_t));
-          any_field = true;
-        } else {
-          std::fill(pf, pf + slice_nnz, (int64_t)0);
-        }
-      }
-      if (a->wide) {
-        if (!pb->wide) {
-          prep(pb->index64, (size_t)nnz_bucket);
-          const uint32_t* s32 = pb->index32.data();
-          uint64_t* d64 = pb->index64.data();
-          for (int64_t k = 0; k < z; ++k) d64[k] = s32[k];
-          pb->wide = true;
-        }
-        std::memcpy(pb->index64.data() + z, a->index64.data() + a_lo,
-                    (size_t)slice_nnz * sizeof(uint64_t));
-      } else if (pb->wide) {
-        const uint32_t* s32 = a->index32.data() + a_lo;
-        uint64_t* d64 = pb->index64.data() + z;
-        for (int64_t k = 0; k < slice_nnz; ++k) d64[k] = s32[k];
-      } else {
-        std::memcpy(pb->index32.data() + z, a->index32.data() + a_lo,
-                    (size_t)slice_nnz * sizeof(uint32_t));
-      }
-      std::memcpy(pb->value.data() + z, a->value.data() + a_lo,
-                  (size_t)slice_nnz * sizeof(float));
-      r += (int64_t)take;
-      z += slice_nnz;
-      carry_row += take;
-      if (carry_row == a->rows()) {
-        // the whole arena is in padded buffers: its bytes return to
-        // the free list NOW, not when the consumer finishes the batch
-        RecycleArena(std::move(carry));
-        carry_row = 0;
-      }
-      batch_ns += now_ns() - t0;
-    }
-    if (r == 0) {
-      recycle_pb();
-      return 0;  // clean end of stream
-    }
-    int64_t t0 = now_ns();
-    if (!t_first) t_first = t0;
-    // neutral pad tails — the exact values the Python fused path writes
-    std::fill(pb->offset.data() + r + 1,
-              pb->offset.data() + row_bucket + 1, z);
-    std::fill(pb->label.data() + r, pb->label.data() + row_bucket, 0.0f);
-    std::fill(pb->weight.data() + r, pb->weight.data() + row_bucket,
-              0.0f);
-    pb->has_qid = want_qid || any_qid;
-    if (pb->has_qid) {
-      if (!qid_filled) {
-        prep(pb->qid, (size_t)row_bucket);
-        std::fill(pb->qid.data(), pb->qid.data() + r, (int64_t)-1);
-      }
-      std::fill(pb->qid.data() + r, pb->qid.data() + row_bucket,
-                (int64_t)-1);
-    }
-    pb->has_field = want_field || any_field;
-    if (pb->has_field) {
-      if (!field_filled) {
-        prep(pb->field, (size_t)nnz_bucket);
-        std::fill(pb->field.data(), pb->field.data() + z, (int64_t)0);
-      }
-      std::fill(pb->field.data() + z, pb->field.data() + nnz_bucket,
-                (int64_t)0);
-    }
-    if (pb->wide)
-      std::fill(pb->index64.data() + z, pb->index64.data() + nnz_bucket,
-                (uint64_t)0);
-    else
-      std::fill(pb->index32.data() + z, pb->index32.data() + nnz_bucket,
-                (uint32_t)0);
-    std::fill(pb->value.data() + z, pb->value.data() + nnz_bucket, 0.0f);
-    pb->num_rows = r;
-    pb->num_nnz = z;
-    batch_ns += now_ns() - t0;
-    stats.assemble_ns += batch_ns;
-    if (trace_on())
-      // one assemble span per padded batch, anchored at its first copy;
-      // duration is copy time only (queue waits between slices already
-      // ride on the Python pull span)
-      ring.Record(kTraceBatchAssemble, kTidConsumer, t_first, batch_ns,
-                  r);
-    PaddedBlock* raw = pb.get();
-    {
-      std::lock_guard<std::mutex> lk(pool_mu);
-      outstanding_padded[raw] = std::move(pb);
-    }
-    *out = raw;
-    return r;
+    return NextPaddedImpl(plane, next, recycle, &stats, &ring, &error,
+                          rows_per_batch, row_bucket, nnz_bucket,
+                          want_qid, want_field, out);
   }
 
   // End-of-stream pool trim. The per-parser free lists exist to recycle
@@ -2781,14 +2979,13 @@ struct ParserHandle {
   // steady-state RSS tracks data actually retained, not pool slack.
   void TrimPools() {
     std::vector<std::unique_ptr<CSRArena>> drop_arenas;
-    std::vector<std::unique_ptr<PaddedBlock>> drop_padded;
     std::vector<std::string> drop_chunks;
     {
       std::lock_guard<std::mutex> lk(pool_mu);
       drop_arenas.swap(arena_pool);
-      drop_padded.swap(padded_pool);
       drop_chunks.swap(chunk_pool);
     }
+    plane.TrimPool();
     // destructors run outside pool_mu: BlockCache::Put takes its own
     // lock and a consumer thread may call Release concurrently
   }
@@ -3111,6 +3308,7 @@ Format parse_format(const char* fmt) {
   if (f == "libsvm") return Format::kLibSVM;
   if (f == "csv") return Format::kCSV;
   if (f == "libfm") return Format::kLibFM;
+  if (f == "recordio_dense") return Format::kRecIODense;
   throw EngineError{"unknown native format: " + f};
 }
 
@@ -3130,9 +3328,18 @@ const char* dtp_last_error() { return g_last_error.c_str(); }
 //     dtp_now_ns/dtp_parser_trace_drain);
 // 5 = native batch assembly (dtp_parser_next_padded/dtp_padded_release/
 //     dtp_parser_start/dtp_parser_outstanding; dtp_parser_stats out
-//     grew to 8 slots — out[7] = assemble_ns).
+//     grew to 8 slots — out[7] = assemble_ns);
+// 6 = dense RecordIO decode + gang assembly: dtp_parser_create accepts
+//     format "recordio_dense" (reader = RecordIOShardReader, frozen
+//     dense payload contract u32 n | f32 label | f32[n] values)
+//     feeding the same arena/NextPadded machinery, and the dtp_gang_*
+//     surface cuts padded batches ACROSS sharded sub-parsers in C
+//     (dtp_gang_create/next_padded/padded_release/outstanding/
+//     assemble_ns/before_first/destroy) — a pre-6 .so silently lacks
+//     both, so the version bump makes a stale engine fail LOUDLY at
+//     load/build instead of at first dense parse.
 // Bump on ANY signature change — bindings.load() refuses mismatches.
-int dtp_version() { return 5; }
+int dtp_version() { return 6; }
 
 // ------------------------------------------------------------- tracing
 
@@ -3183,8 +3390,12 @@ void* dtp_parser_create(const char** paths, const int64_t* sizes,
     std::vector<FileEntry> files;
     for (int64_t i = 0; i < nfiles; ++i)
       files.push_back({paths[i], sizes[i]});
-    h->reader = std::make_unique<TextShardReader>(
-        std::move(files), part, nparts, chunk_bytes);
+    if (h->cfg.format == Format::kRecIODense)
+      h->reader = std::make_unique<RecordIOShardReader>(
+          std::move(files), part, nparts, chunk_bytes);
+    else
+      h->reader = std::make_unique<TextShardReader>(
+          std::move(files), part, nparts, chunk_bytes);
     return h.release();
   } catch (const EngineError& e) {
     g_last_error = e.msg;
@@ -3321,9 +3532,9 @@ void dtp_parser_before_first(void* handle) {
   // padded-emission carry state resets with the epoch (the partially
   // consumed arena goes back to the pool; leased padded blocks stay
   // valid until released, same contract as CSR leases)
-  if (h->carry) h->RecycleArena(std::move(h->carry));
-  h->carry_row = 0;
-  h->padded_eof = false;
+  h->plane.Reset([h](std::unique_ptr<CSRArena> a, void*) {
+    h->RecycleArena(std::move(a));
+  });
   // outstanding blocks stay valid across epochs until released;
   // pipeline restarts lazily on next()
 }
@@ -3417,6 +3628,146 @@ int64_t dtp_parser_total_size(void* handle) {
 
 void dtp_parser_destroy(void* handle) {
   delete static_cast<ParserHandle*>(handle);
+}
+
+// --------------------------------------------- sharded gang assembly
+// ABI 6: padded emission ACROSS a gang of sharded sub-parsers. The
+// Python side (bindings.NativeShardedTextParser) splits one file over
+// N parser handles on aligned byte ranges; a GangHandle drains their
+// arena streams in shard order through the SAME NextPaddedImpl a
+// single parser uses — so batches are cut across shard boundaries
+// exactly as the 1-parser stream would cut them (byte-identical
+// layout, pinned by tests), the pad+stack memcpy stays in C with the
+// GIL released, and each fully-cut arena recycles to its OWN
+// sub-parser's free list. Without this, a sharded parse paid the
+// Python fused pad per batch — which BOUND the sharded dense-decode
+// path below the unsharded native one (config 14's original numbers).
+
+namespace {
+
+struct GangHandle {
+  std::vector<ParserHandle*> subs;  // borrowed: bindings owns each
+  size_t cur = 0;                   // sub currently being drained
+  PaddedPlane plane;
+  PipelineStats stats;              // assemble_ns only (subs own I/O)
+  std::string error;
+
+  int64_t NextPadded(int64_t rows_per_batch, int64_t row_bucket,
+                     int64_t nnz_bucket, bool want_qid, bool want_field,
+                     PaddedBlock** out) {
+    auto next = [this](std::unique_ptr<CSRArena>* a, void** origin)
+        -> int64_t {
+      while (cur < subs.size()) {
+        int64_t r = subs[cur]->NextArena(a);
+        if (r < 0) {
+          error = subs[cur]->error;
+          return -1;
+        }
+        if (r > 0) {
+          *origin = subs[cur];
+          return r;
+        }
+        ++cur;  // shard drained; the next one's window is already full
+      }
+      return 0;
+    };
+    auto recycle = [](std::unique_ptr<CSRArena> a, void* origin) {
+      static_cast<ParserHandle*>(origin)->RecycleArena(std::move(a));
+    };
+    // assemble spans ride sub 0's ring (one consumer track per gang)
+    return NextPaddedImpl(plane, next, recycle, &stats,
+                          subs.empty() ? nullptr : &subs.front()->ring,
+                          &error, rows_per_batch, row_bucket,
+                          nnz_bucket, want_qid, want_field, out);
+  }
+
+  void BeforeFirst() {
+    plane.Reset([](std::unique_ptr<CSRArena> a, void* origin) {
+      static_cast<ParserHandle*>(origin)->RecycleArena(std::move(a));
+    });
+    cur = 0;
+    error.clear();
+    stats.Reset();
+    // the sub-parsers' own before_first/start is the Python side's job
+  }
+};
+
+}  // namespace
+
+// Build a gang over existing parser handles (NOT owned: destroy the
+// gang first, then each sub via dtp_parser_destroy).
+void* dtp_gang_create(void** parser_handles, int64_t n) {
+  auto g = std::make_unique<GangHandle>();
+  for (int64_t i = 0; i < n; ++i)
+    g->subs.push_back(static_cast<ParserHandle*>(parser_handles[i]));
+  return g.release();
+}
+
+// Same contract and out-param layout as dtp_parser_next_padded; the
+// lease releases via dtp_gang_padded_release(gang, block).
+int64_t dtp_gang_next_padded(
+    void* gang, int64_t rows_per_batch, int64_t row_bucket,
+    int64_t nnz_bucket, int want_qid, int want_field, void** block_out,
+    const int64_t** offset, const float** label, const float** weight,
+    const float** value, const uint32_t** index32,
+    const uint64_t** index64, const int64_t** qid, const int64_t** field,
+    int64_t* num_nnz, int* wide, int* has_qid, int* has_field) {
+  auto* g = static_cast<GangHandle*>(gang);
+  PaddedBlock* b = nullptr;
+  int64_t rows = g->NextPadded(rows_per_batch, row_bucket, nnz_bucket,
+                               want_qid != 0, want_field != 0, &b);
+  if (rows < 0) {
+    g_last_error = g->error;
+    return -1;
+  }
+  if (rows == 0) return 0;
+  *block_out = b;
+  *offset = b->offset.data();
+  *label = b->label.data();
+  *weight = b->weight.data();
+  *value = b->value.data();
+  if (b->wide) {
+    *index32 = nullptr;
+    *index64 = b->index64.data();
+  } else {
+    *index32 = b->index32.data();
+    *index64 = nullptr;
+  }
+  *qid = b->has_qid ? b->qid.data() : nullptr;
+  *field = b->has_field ? b->field.data() : nullptr;
+  *num_nnz = b->num_nnz;
+  *wide = b->wide ? 1 : 0;
+  *has_qid = b->has_qid ? 1 : 0;
+  *has_field = b->has_field ? 1 : 0;
+  return rows;
+}
+
+void dtp_gang_padded_release(void* gang, void* block) {
+  if (!gang || !block) return;
+  static_cast<GangHandle*>(gang)->plane.Release(
+      static_cast<PaddedBlock*>(block));
+}
+
+// Gang-held padded leases (the sub-parsers report their own CSR
+// leases through dtp_parser_outstanding).
+int64_t dtp_gang_outstanding(void* gang) {
+  return (int64_t)static_cast<GangHandle*>(gang)
+      ->plane.OutstandingCount();
+}
+
+// Consumer-side pad+stack copy time across the gang's batches
+// (comparable to dtp_parser_stats out[7] for a single parser).
+int64_t dtp_gang_assemble_ns(void* gang) {
+  return static_cast<GangHandle*>(gang)->stats.assemble_ns.load();
+}
+
+void dtp_gang_before_first(void* gang) {
+  if (!gang) return;
+  static_cast<GangHandle*>(gang)->BeforeFirst();
+}
+
+void dtp_gang_destroy(void* gang) {
+  delete static_cast<GangHandle*>(gang);
 }
 
 // ------------------------------------------------- recordio reader ABI

@@ -7,6 +7,7 @@
 // ParseLibSVMSlice/ParseCSVSlice and the in-place RecordIO stitch.
 
 #include "engine.cc"
+#include "recordio_test_util.h"
 
 #include <cstdio>
 #include <random>
@@ -132,6 +133,24 @@ std::string make_recordio(int records,
   return out;
 }
 
+// dense recordio corpus: valid framed dense payloads (u32 n | f32
+// label | f32[n] values), a few with an aligned in-payload magic so
+// the escaped multi-frame shape appears in the UNmutated base too
+// (framing via the shared recordio_test_util.h escaping writer)
+std::string make_dense_recordio(int records) {
+  std::string out;
+  for (int i = 0; i < records; ++i) {
+    uint32_t n = (uint32_t)(g_rng() % 40);
+    std::vector<float> vals(n);
+    for (auto& v : vals) v = (float)(g_rng() % 10000) / 100.0f;
+    if (n >= 2 && i % 7 == 0)  // value bits == frame magic, 4-aligned
+      std::memcpy(vals.data(), &kRecIOMagic, 4);
+    float label = (float)(int)(g_rng() % 5) - 2.0f;
+    append_recordio_record(&out, dense_payload(label, vals));
+  }
+  return out;
+}
+
 void mutate(std::string* s) {
   if (s->empty()) return;
   int kind = (int)(g_rng() % 4);
@@ -178,6 +197,25 @@ int fuzz_text(Format fmt, const std::string& base, int iters) {
       }
     } catch (const EngineError&) {
       ++threw;  // rejection is fine; crashing/OOB is not (ASAN checks)
+    }
+  }
+  return threw;
+}
+
+// ABI-6 dense decode under corruption: truncated frames/payloads, bad
+// n_values (a length that disagrees with the payload), garbage — must
+// reject via EngineError, never read/write out of bounds (the raw
+// arena cursors are reserve-bounded; ASAN enforces)
+int fuzz_dense(const std::string& base, int iters) {
+  int threw = 0;
+  for (int i = 0; i < iters; ++i) {
+    std::string data = base;
+    for (int m = (int)(g_rng() % 6); m >= 0; --m) mutate(&data);
+    CSRArena a;
+    try {
+      ParseRecIODenseSlice(data.data(), data.size(), &a);
+    } catch (const EngineError&) {
+      ++threw;
     }
   }
   return threw;
@@ -287,13 +325,16 @@ int main(int argc, char** argv) {
   int t6 = fuzz_text(Format::kLibSVM, make_libsvm_short(60), iters);
   int t7 = fuzz_text(Format::kLibSVM, make_libsvm_fixed6(60), iters);
   int t8 = fuzz_text(Format::kCSV, make_csv_fixed6(40, 8), iters);
+  // ABI-6 dense decode (incl. escaped-magic multi-frame records in
+  // the unmutated base — the stitch path runs under ASAN too)
+  int t9 = fuzz_dense(make_dense_recordio(60), iters);
   // sanity: the corrupting fuzz must actually hit rejection paths
   std::printf("fuzz complete: rejects libsvm=%d csv=%d libfm=%d "
               "recordio=%d recidx=%d short=%d fixed6=%d csv6=%d "
-              "of %d each\n",
-              t1, t2, t3, t4, t5, t6, t7, t8, iters);
+              "dense=%d of %d each\n",
+              t1, t2, t3, t4, t5, t6, t7, t8, t9, iters);
   if (t1 == 0 || t2 == 0 || t3 == 0 || t4 == 0 || t5 <= 0 || t6 == 0 ||
-      t7 == 0 || t8 == 0) {
+      t7 == 0 || t8 == 0 || t9 == 0) {
     std::fprintf(stderr, "fuzz too weak: no rejections seen\n");
     return 1;
   }

@@ -9,6 +9,7 @@
 // boundary rule (coverage + no-overlap at byte granularity).
 
 #include "engine.cc"
+#include "recordio_test_util.h"
 
 #include <cstdlib>
 #include <fstream>
@@ -374,6 +375,139 @@ static void test_recordio_shard_coverage() {
   }
 }
 
+// ------------------------------------------------- dense recordio (ABI 6)
+// append_recordio_record / dense_payload come from recordio_test_util.h
+// (shared with engine_fuzz.cc so the pinned escaping contract cannot
+// drift between the two test binaries)
+
+static void test_dense_decode() {
+  // decode correctness incl. a value whose f32 bits ARE the frame
+  // magic at a 4-aligned payload position (escaped -> multi-frame ->
+  // stitched through the scratch path), a zero-value record, and the
+  // row/offset/index-range invariants
+  float magicf;
+  std::memcpy(&magicf, &kRecIOMagic, 4);
+  std::vector<std::vector<float>> rows = {
+      {1.5f, -2.25f, 3.0f},
+      {},                            // n_values = 0
+      {magicf, 7.0f},                // aligned magic at payload + 8
+      {0.25f},
+      {9.0f, magicf, magicf, 1.0f},  // two escapes in one record
+  };
+  std::string chunk;
+  for (size_t i = 0; i < rows.size(); ++i)
+    append_recordio_record(&chunk, dense_payload((float)i, rows[i]));
+  CSRArena a;
+  ParseRecIODenseSlice(chunk.data(), chunk.size(), &a);
+  CHECK_EQ_(a.rows(), rows.size());
+  size_t nnz = 0;
+  for (size_t r = 0; r < rows.size(); ++r) {
+    CHECK_EQ_(a.label[r], (float)r);
+    CHECK_EQ_((size_t)(a.offset[r + 1] - a.offset[r]), rows[r].size());
+    for (size_t k = 0; k < rows[r].size(); ++k) {
+      CHECK_EQ_(a.index32[nnz], (uint32_t)k);
+      uint32_t gb, wb;  // bit-exact values, incl. the magic-bit float
+      std::memcpy(&gb, &a.value[nnz], 4);
+      std::memcpy(&wb, &rows[r][k], 4);
+      CHECK_EQ_(gb, wb);
+      ++nnz;
+    }
+  }
+  CHECK_EQ_(a.nnz(), nnz);
+  CHECK_EQ_(a.min_index, (uint64_t)0);
+  CHECK_EQ_(a.max_index, (uint64_t)3);  // longest row has 4 values
+
+  // bad n_values: payload claims more values than its bytes carry
+  {
+    std::string p = dense_payload(1.0f, {1.0f, 2.0f});
+    uint32_t bogus = 100;
+    std::memcpy(p.data(), &bogus, 4);
+    std::string c;
+    append_recordio_record(&c, p);
+    CSRArena b;
+    bool threw = false;
+    try {
+      ParseRecIODenseSlice(c.data(), c.size(), &b);
+    } catch (const EngineError&) {
+      threw = true;
+    }
+    CHECK_TRUE(threw);
+  }
+  // payload shorter than the 8-byte dense header
+  {
+    std::string c;
+    append_recordio_record(&c, std::string(4, 'x'));
+    CSRArena b;
+    bool threw = false;
+    try {
+      ParseRecIODenseSlice(c.data(), c.size(), &b);
+    } catch (const EngineError&) {
+      threw = true;
+    }
+    CHECK_TRUE(threw);
+  }
+  // truncated frame: cut mid-payload
+  {
+    std::string c;
+    append_recordio_record(&c, dense_payload(1.0f, {1.0f, 2.0f, 3.0f}));
+    c.resize(c.size() - 6);
+    CSRArena b;
+    bool threw = false;
+    try {
+      ParseRecIODenseSlice(c.data(), c.size(), &b);
+    } catch (const EngineError&) {
+      threw = true;
+    }
+    CHECK_TRUE(threw);
+  }
+}
+
+// dense shard coverage: every record in exactly one part at any
+// nparts/chunk size, through the REAL reader (mmap views + buffered)
+static void test_dense_shard_coverage() {
+  std::string dir = "/tmp/dtp_engine_unittest_dense";
+  std::system(("rm -rf " + dir + " && mkdir -p " + dir).c_str());
+  srand(17);
+  float magicf;
+  std::memcpy(&magicf, &kRecIOMagic, 4);
+  std::vector<FileEntry> files;
+  int total_rows = 0;
+  for (int f = 0; f < 2; ++f) {
+    std::string path = dir + "/part" + std::to_string(f) + ".rec";
+    std::string bytes;
+    for (int i = 0; i < 400; ++i) {
+      std::vector<float> vals((size_t)(rand() % 30));
+      for (auto& v : vals) v = (float)(rand() % 1000) / 8.0f;
+      if (!vals.empty() && i % 11 == 0) vals[0] = magicf;
+      // the label IS the global ordinal: coverage check reads it back
+      append_recordio_record(&bytes,
+                             dense_payload((float)total_rows, vals));
+      ++total_rows;
+    }
+    std::ofstream out(path, std::ios::binary);
+    out.write(bytes.data(), (std::streamsize)bytes.size());
+    out.close();
+    files.push_back({path, (int64_t)bytes.size()});
+  }
+  for (int nparts : {1, 3}) {
+    for (int64_t chunk : {1, 1 << 20}) {
+      std::multiset<int64_t> seen;
+      for (int part = 0; part < nparts; ++part) {
+        RecordIOShardReader r(files, part, nparts, chunk);
+        CSRArena a;
+        std::string buf;
+        while (r.NextChunk(&buf))
+          ParseRecIODenseSlice(buf.data(), buf.size(), &a);
+        for (size_t row = 0; row < a.rows(); ++row)
+          seen.insert((int64_t)a.label[row]);
+      }
+      CHECK_EQ_(seen.size(), (size_t)total_rows);
+      CHECK_TRUE(std::set<int64_t>(seen.begin(), seen.end()).size() ==
+                 seen.size());
+    }
+  }
+}
+
 static void test_block_cache() {
   // semantics the fault-elimination story rides on (r4): best-fit
   // >=-matching over 2 MB-granular classes, accurate budget
@@ -430,6 +564,8 @@ int main() {
   test_shard_coverage();
   test_view_buffered_parity();  // needs test_shard_coverage's fixture
   test_recordio_shard_coverage();
+  test_dense_decode();
+  test_dense_shard_coverage();
   if (g_failures) {
     std::cerr << g_failures << " native unit-test failures\n";
     return 1;

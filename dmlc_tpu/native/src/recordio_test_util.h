@@ -1,0 +1,53 @@
+// Shared test-side RecordIO writer helpers (engine_unittest.cc +
+// engine_fuzz.cc). Mirrors the GOLDEN writer's escaping contract
+// (dmlc_tpu/io/recordio.py RecordIOWriter.write_record): aligned magic
+// occurrences in a payload become frame boundaries (cflag 1 start /
+// 2 middle / 3 end), so the byte stream never carries the magic at a
+// 4-aligned position except at frame heads. ONE implementation — the
+// escaping contract these test binaries exist to pin must not be able
+// to drift between them. Include AFTER engine.cc (uses kRecIOMagic /
+// load_u32le).
+
+#ifndef DMLC_TPU_RECORDIO_TEST_UTIL_H_
+#define DMLC_TPU_RECORDIO_TEST_UTIL_H_
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// frame one payload with the golden writer's escaping contract
+inline void append_recordio_record(std::string* out,
+                                   const std::string& payload) {
+  size_t n = payload.size();
+  size_t scan_end = (n >> 2) << 2;
+  size_t start = 0;
+  for (size_t pos = 0; pos + 4 <= scan_end; pos += 4) {
+    if (load_u32le(payload.data() + pos) != kRecIOMagic) continue;
+    uint32_t lrec =
+        ((start == 0 ? 1u : 2u) << 29) | (uint32_t)(pos - start);
+    out->append((const char*)&kRecIOMagic, 4);
+    out->append((const char*)&lrec, 4);
+    out->append(payload.data() + start, pos - start);
+    out->append((4 - ((pos - start) & 3)) & 3, '\0');
+    start = pos + 4;
+  }
+  uint32_t lrec = ((start ? 3u : 0u) << 29) | (uint32_t)(n - start);
+  out->append((const char*)&kRecIOMagic, 4);
+  out->append((const char*)&lrec, 4);
+  out->append(payload.data() + start, n - start);
+  out->append((4 - ((n - start) & 3)) & 3, '\0');
+}
+
+// one ABI-6 dense payload: u32 n_values | f32 label | f32[n] values
+inline std::string dense_payload(float label,
+                                 const std::vector<float>& vals) {
+  std::string p(8 + 4 * vals.size(), '\0');
+  uint32_t n = (uint32_t)vals.size();
+  std::memcpy(&p[0], &n, 4);
+  std::memcpy(&p[4], &label, 4);
+  if (n) std::memcpy(&p[8], vals.data(), 4 * vals.size());
+  return p;
+}
+
+#endif  // DMLC_TPU_RECORDIO_TEST_UTIL_H_

@@ -148,16 +148,22 @@ def attribute(pipeline_snap: Dict[str, Any],
     total_wait = sum(per_stage.values())
 
     # wire side: pagestore hit rate + objstore GET traffic (cumulative
-    # process counters — a cold remote epoch shows misses and GETs)
+    # process counters — a cold remote epoch shows misses and GETs).
+    # objstore.bytes counts ON-WIRE bytes (compressed when the page
+    # codec is on); objstore.bytes_served the decompressed payload —
+    # the wire-heaviness judgment uses the SERVED side (that is what
+    # the pipeline consumed), the evidence names both rates.
     ps_hit = _counter(metrics, "pagestore.hit")
     ps_miss = _counter(metrics, "pagestore.miss")
     obj_gets = _counter(metrics, "objstore.get")
     obj_bytes = _counter(metrics, "objstore.bytes")
+    obj_served = _counter(metrics, "objstore.bytes_served")
+    obj_payload = obj_served or obj_bytes
     hit_rate = (ps_hit / (ps_hit + ps_miss)
                 if (ps_hit + ps_miss) else None)
     pipeline_bytes = max((int(st.get("bytes") or 0) for st in stages),
                          default=0)
-    wire_heavy = (obj_gets > 0 and obj_bytes >= 0.5 * pipeline_bytes
+    wire_heavy = (obj_gets > 0 and obj_payload >= 0.5 * pipeline_bytes
                   and (hit_rate is None or hit_rate < 0.5))
 
     band = run_band or _modal_band(epoch_gauges)
@@ -182,9 +188,20 @@ def attribute(pipeline_snap: Dict[str, Any],
         evidence.append(f"pagestore hit rate {hit_rate:.2f} "
                         f"({int(ps_hit)} hit / {int(ps_miss)} miss)")
     if obj_gets:
-        evidence.append(f"objstore: {int(obj_gets)} GETs, "
-                        f"{int(obj_bytes)} wire bytes vs "
-                        f"{pipeline_bytes} pipeline bytes")
+        line = (f"objstore: {int(obj_gets)} GETs, "
+                f"{int(obj_bytes)} wire bytes vs "
+                f"{pipeline_bytes} pipeline bytes")
+        if obj_served > obj_bytes:
+            # page codec on: the wire moved fewer bytes than it served
+            line += (f" (codec: {int(obj_served)} bytes served from "
+                     f"{int(obj_bytes)} on-wire, "
+                     f"{obj_served / obj_bytes:.1f}x")
+            if wall > 0:
+                line += (f"; {obj_bytes / wall / 1e9:.3f} GB/s "
+                         "compressed wire -> "
+                         f"{obj_served / wall / 1e9:.3f} GB/s served")
+            line += ")"
+        evidence.append(line)
     for name, occ in occupancies:
         if occ >= 0.8:
             evidence.append(f"queue {name} {occ:.0%} full "
@@ -258,7 +275,10 @@ def load_bench(path_or_doc) -> Dict[str, Any]:
     else:
         with open(path_or_doc) as f:
             doc = json.load(f)
-    if "metric" in doc or "pipeline" in doc:
+    # bench_suite config lines (config 14 recio_native etc.) carry
+    # "config" + "gbps": comparable band-for-band via their
+    # epoch_gauges/gbps fallback in _bands_of
+    if "metric" in doc or "pipeline" in doc or "config" in doc:
         return doc
     if isinstance(doc.get("parsed"), dict):
         return doc["parsed"]
@@ -293,6 +313,8 @@ def _bands_of(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     value = doc.get("sustained_gauge_ok")
     if value is None:
         value = doc.get("value")
+    if value is None:
+        value = doc.get("gbps")  # bench_suite config lines
     if value is not None:
         out[band] = {"sustained": value, "epochs": doc.get("epochs")}
     return out

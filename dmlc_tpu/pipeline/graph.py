@@ -1152,10 +1152,12 @@ class Pipeline:
             up = runners[-1]
             if spec.kind == "batch" and spec.params.get("pad"):
                 # padded assembly sitting DIRECTLY on a native-engine
-                # parse fuses into the engine's ABI-5 batch assembly;
-                # anything else (python engine, cache/shuffle upstream,
-                # sharded parser, map between) pads through the Python
-                # fused golden — byte-identical by the pinned contract
+                # parse fuses into the engine's batch assembly (ABI-5
+                # single parser, or the ABI-6 gang for a sharded
+                # parse — NativeShardedTextParser.next_padded); anything
+                # else (python engine, cache/shuffle upstream, map
+                # between) pads through the Python fused golden —
+                # byte-identical by the pinned contract
                 if (len(runners) == 1 and isinstance(up, _ParseRunner)
                         and hasattr(up._parser, "next_padded")):
                     runners[-1] = _NativeAssembleRunner(up, spec)

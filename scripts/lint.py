@@ -35,6 +35,11 @@ without the tools baked in:
   ``scripts/`` must match it exactly — the ``/analyze`` endpoint,
   bench JSON ``"analysis"`` blocks, and ``scripts/obsctl.py`` can
   never drift apart.
+- **Codec gate** (always run, AST-based): direct ``zlib``/``gzip``/
+  ``bz2``/``lzma`` imports inside ``dmlc_tpu/`` are forbidden outside
+  ``io/codec.py`` (the one compressed-page seam; the pinned exception:
+  ``resilience/policy.py``'s ``zlib.crc32`` jitter hash) — page bytes
+  compress through one self-describing frame, never ad-hoc streams.
 - **Steady-path gate** (always run, AST-based): inside
   ``dmlc_tpu/data/`` and ``dmlc_tpu/pipeline/``, per-row Python loops
   over block payloads (``for row in …`` or ``range(<x>.size)`` index
@@ -287,6 +292,52 @@ def io_seam_lint(paths: List[str],
                     "dmlc_tpu/io/ — stat through "
                     "io.pagestore.stat_uri / FileSystem.get_path_info "
                     "so remote schemes and fault plans apply")
+    return findings
+
+
+# Compression is a SEAM (dmlc_tpu/io/codec.py: one self-describing
+# page frame, one level contract, one corruption story the retry seams
+# rely on), not a per-call-site choice: a direct zlib/gzip/bz2/lzma
+# import elsewhere in the package would mint a second on-disk/on-wire
+# byte format the sweep, the sidecar stamps, and the chaos tests never
+# see. The one pinned exception is resilience/policy.py's zlib.crc32 —
+# a deterministic jitter HASH, not compression. The list shrinks, it
+# does not grow.
+CODEC_ALLOWED = {"dmlc_tpu/io/codec.py"}
+CODEC_CRC_ALLOWED = {"dmlc_tpu/resilience/policy.py"}
+_CODEC_MODULES = {"zlib", "gzip", "bz2", "lzma"}
+
+
+def codec_lint(paths: List[str],
+               trees: Optional[dict] = None) -> List[str]:
+    """The codec gate: no direct compression-module imports in
+    dmlc_tpu/ outside io/codec.py (see above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel in CODEC_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name.split(".")[0] for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [node.module.split(".")[0]]
+            hit = sorted(set(mods) & _CODEC_MODULES)
+            if not hit:
+                continue
+            if rel in CODEC_CRC_ALLOWED and hit == ["zlib"]:
+                continue  # the pinned crc32 jitter-hash use
+            findings.append(
+                f"{rel}:{node.lineno}: direct {'/'.join(hit)} import "
+                "outside io/codec.py — page bytes compress through "
+                "dmlc_tpu.io.codec (encode_page/decode_page) so the "
+                "frame header, sidecar stamps and corruption handling "
+                "stay one contract")
     return findings
 
 
@@ -577,6 +628,7 @@ def main() -> int:
     findings += io_seam_lint(paths, trees)
     findings += row_loop_lint(paths, trees)
     findings += verdict_lint(paths, trees)
+    findings += codec_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

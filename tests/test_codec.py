@@ -1,0 +1,340 @@
+"""The compressed-page codec (io/codec.py) and its three seams: spill
+round pages, hydrated objstore blocks (sidecar-stamped), and the
+transfer-encoded wire — plus the analyze/compare plumbing that keeps
+the accounting honest (compressed on-wire vs served bytes)."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io.codec import (
+    ENV_LEVEL, HEADER_BYTES, decode_page, default_level, encode_page,
+    is_encoded, tag,
+)
+from dmlc_tpu.utils.logging import DMLCError
+
+
+class TestCodecFrame:
+    @pytest.mark.parametrize("level", [0, 1, 6, 9])
+    def test_roundtrip_property(self, level):
+        rng = np.random.default_rng(level)
+        cases = [
+            b"",                          # empty page
+            b"ab" * 50000,                # highly compressible
+            rng.bytes(20000),             # incompressible (random)
+            b"DTPC" + b"payload" * 64,    # raw input wearing the magic
+            encode_page(b"x" * 4096, 6),  # already-encoded input
+        ]
+        for data in cases:
+            enc = encode_page(data, level)
+            assert decode_page(enc) == data
+            # incompressible input never grows more than the header
+            assert len(enc) <= len(data) + HEADER_BYTES
+
+    def test_level0_is_passthrough(self):
+        data = b"raw bytes, no frame"
+        assert encode_page(data, 0) == data
+        assert decode_page(data) == data  # plain bytes pass through
+
+    def test_level0_magic_input_gets_stored_frame(self):
+        # raw input that happens to start with the frame magic must be
+        # wrapped, or decode would misread it
+        data = b"DTPC" + b"\x00" * 64
+        enc = encode_page(data, 0)
+        assert enc != data and is_encoded(enc)
+        assert decode_page(enc) == data
+
+    def test_compression_actually_shrinks(self):
+        data = b"0 1:0.5 2:0.25\n" * 10000
+        enc = encode_page(data, 6)
+        assert len(enc) < len(data) // 4
+        assert tag(6) == "zlib:6" and tag(0) == "raw"
+
+    def test_corrupt_frames_raise(self):
+        enc = encode_page(b"payload" * 1000, 6)
+        flipped = bytearray(enc)
+        flipped[HEADER_BYTES + 5] ^= 0xFF
+        with pytest.raises(DMLCError):
+            decode_page(bytes(flipped))
+        with pytest.raises(DMLCError):        # truncated payload
+            decode_page(enc[: HEADER_BYTES + 3])
+        with pytest.raises(DMLCError):        # truncated header
+            decode_page(enc[:10])
+        bad_ver = bytearray(enc)
+        bad_ver[4] = 99
+        with pytest.raises(DMLCError, match="version"):
+            decode_page(bytes(bad_ver))
+        bad_codec = bytearray(enc)
+        bad_codec[5] = 7
+        with pytest.raises(DMLCError, match="codec id"):
+            decode_page(bytes(bad_codec))
+
+    def test_crc_catches_stored_corruption(self):
+        enc = encode_page(b"DTPC" + b"\x11" * 100, 0)  # stored frame
+        tampered = bytearray(enc)
+        tampered[-1] ^= 0x01
+        with pytest.raises(DMLCError):
+            decode_page(bytes(tampered))
+
+    def test_env_default_level(self, monkeypatch):
+        monkeypatch.delenv(ENV_LEVEL, raising=False)
+        assert default_level() == 0
+        monkeypatch.setenv(ENV_LEVEL, "6")
+        assert default_level() == 6
+        monkeypatch.setenv(ENV_LEVEL, "40")
+        assert default_level() == 9  # clamped
+        monkeypatch.setenv(ENV_LEVEL, "junk")
+        assert default_level() == 0
+
+
+def _mkblock(seed, rows=40):
+    from dmlc_tpu.data.rowblock import RowBlockContainer
+    rng = np.random.default_rng(seed)
+    c = RowBlockContainer(np.uint32)
+    for i in range(rows):
+        n = int(rng.integers(1, 16))
+        c.push(float(i), np.arange(n, dtype=np.uint32),
+               rng.standard_normal(n).astype(np.float32))
+    return c.get_block()
+
+
+class TestSpillCodec:
+    def _roundtrip(self, tmp_path, level):
+        from dmlc_tpu.data.row_iter import RoundSpillWriter, \
+            read_spill_meta
+        path = str(tmp_path / f"spill{level}.pages")
+        rows = [[_mkblock(r * 2 + p) for p in range(2)]
+                for r in range(5)]
+        w = RoundSpillWriter(path, nparts=2, codec_level=level)
+        for row in rows:
+            w.add_row(row)
+        f = w.commit()
+        h = hashlib.sha256()
+        n = 0
+        for row in f.iter_rows():
+            for b in row:
+                h.update(b.content_hash().encode())
+            n += 1
+        assert n == 5
+        return h.hexdigest(), os.path.getsize(path), \
+            read_spill_meta(path)
+
+    def test_v2_replay_byte_identical_and_smaller(self, tmp_path):
+        raw_h, raw_sz, raw_meta = self._roundtrip(tmp_path, 0)
+        z_h, z_sz, z_meta = self._roundtrip(tmp_path, 6)
+        assert raw_h == z_h, "codec changed replayed content"
+        assert z_sz < raw_sz, "no NVMe savings"
+        assert raw_meta["_version"] == 1 and raw_meta["codec"] == "raw"
+        assert z_meta["_version"] == 2 and z_meta["codec"] == "zlib:6"
+
+    def test_sidecar_stamps_codec(self, tmp_path):
+        from dmlc_tpu.data.row_iter import RoundSpillWriter
+        from dmlc_tpu.io.pagestore import PageStore
+        path = str(tmp_path / "st.pages")
+        w = RoundSpillWriter(path, nparts=1, codec_level=6)
+        w.add_row([_mkblock(0)])
+        w.commit()
+        store, entry = PageStore.for_path(path)
+        assert store.stamp(entry)["codec"] == "zlib:6"
+
+    def test_env_level_applies(self, tmp_path, monkeypatch):
+        from dmlc_tpu.data.row_iter import RoundSpillWriter, \
+            read_spill_meta
+        monkeypatch.setenv(ENV_LEVEL, "4")
+        path = str(tmp_path / "env.pages")
+        w = RoundSpillWriter(path, nparts=1)
+        w.add_row([_mkblock(1)])
+        w.commit()
+        assert read_spill_meta(path)["codec"] == "zlib:4"
+
+
+@pytest.fixture
+def emulated_store(tmp_path):
+    import dmlc_tpu.io.objstore as objstore
+    from dmlc_tpu.io.pagestore import PageStore
+    em = objstore.configure(root=str(tmp_path / "objroot"))
+    store = PageStore.default()
+    yield em, store
+
+    def _scrub():
+        if os.path.isdir(store.root):
+            for name in os.listdir(store.root):
+                if name.startswith("obj-"):
+                    store.delete(name)
+
+    _scrub()
+    objstore.configure(None)
+    from dmlc_tpu.io.objstore import fs as _objfs
+    _objfs._options["codec_level"] = None
+
+
+def _read_uri(uri):
+    from dmlc_tpu.io.filesys import URI, FileSystem
+    s = FileSystem.get_instance(URI(uri)).open_for_read(URI(uri))
+    out = b""
+    while True:
+        c = s.read(1 << 20)
+        if not c:
+            break
+        out += c
+    s.close()
+    return out
+
+
+def _drop_hydrated(store):
+    for name in (os.listdir(store.root)
+                 if os.path.isdir(store.root) else []):
+        if name.startswith("obj-"):
+            store.delete(name)
+
+
+class TestObjstoreCodec:
+    CORPUS = b"0 1:0.5 2:0.25 3:0.125\n" * 120000
+
+    def test_compressed_hydrate_wire_and_parity(self, emulated_store):
+        import dmlc_tpu.io.objstore as objstore
+        from dmlc_tpu.obs.metrics import REGISTRY
+        em, store = emulated_store
+        em.put("b", "k.txt", self.CORPUS)
+        # uncompressed baseline
+        _drop_hydrated(store)
+        em.reset_counters()
+        raw = _read_uri("obj://b/k.txt")
+        raw_wire = em.counters()["get_bytes"]
+        assert raw == self.CORPUS
+        # compressed cold epoch: fewer wire bytes, same served bytes
+        objstore.configure(codec_level=6)
+        _drop_hydrated(store)
+        em.reset_counters()
+        b0 = REGISTRY.counter("objstore.bytes").value
+        s0 = REGISTRY.counter("objstore.bytes_served").value
+        got = _read_uri("obj://b/k.txt")
+        cold = em.counters()
+        wire = REGISTRY.counter("objstore.bytes").value - b0
+        served = REGISTRY.counter("objstore.bytes_served").value - s0
+        assert got == self.CORPUS, "compressed epoch changed the bytes"
+        assert served == len(self.CORPUS)
+        assert wire < raw_wire, "codec moved no fewer wire bytes"
+        assert cold["get_bytes"] == wire, \
+            "emulator ground truth disagrees with the wire counter"
+        # hydrated entries are stored encoded, sidecar stamped
+        names = [n for n in os.listdir(store.root)
+                 if n.startswith("obj-") and n.endswith(".pages")]
+        assert names
+        assert store.stamp(names[0])["codec"] == "zlib:6"
+        on_disk = sum(os.path.getsize(os.path.join(store.root, n))
+                      for n in names)
+        assert on_disk < len(self.CORPUS), "hydrated pages not encoded"
+        # warm epoch: zero GETs, still byte-identical
+        em.reset_counters()
+        assert _read_uri("obj://b/k.txt") == self.CORPUS
+        assert em.counters()["gets"] == 0
+
+    @pytest.mark.parametrize(
+        "plan", ["site=io.objstore.get,fault=truncate,times=2",
+                 "site=io.objstore.get,fault=ioerror,times=2"])
+    def test_chaos_on_encoded_wire_byte_identical(self, emulated_store,
+                                                  plan):
+        import dmlc_tpu.io.objstore as objstore
+        from dmlc_tpu.resilience import inject
+        em, store = emulated_store
+        em.put("b", "k.txt", self.CORPUS)
+        objstore.configure(codec_level=6)
+        _drop_hydrated(store)
+        armed = inject.install(plan)
+        try:
+            got = _read_uri("obj://b/k.txt")
+        finally:
+            inject.uninstall()
+        assert armed.injected >= 2
+        assert got == self.CORPUS, \
+            f"chaos under {plan} broke byte identity"
+
+    def test_corrupt_hydrated_page_refetches(self, emulated_store):
+        import dmlc_tpu.io.objstore as objstore
+        em, store = emulated_store
+        em.put("b", "k.txt", self.CORPUS)
+        objstore.configure(codec_level=6)
+        _drop_hydrated(store)
+        assert _read_uri("obj://b/k.txt") == self.CORPUS
+        # tamper with a hydrated encoded page: the read must detect the
+        # torn frame, delete it, and refetch — never serve garbage
+        names = sorted(n for n in os.listdir(store.root)
+                       if n.startswith("obj-") and n.endswith(".pages"))
+        p = os.path.join(store.root, names[0])
+        blob = bytearray(open(p, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(p, "wb").write(bytes(blob))
+        em.reset_counters()
+        assert _read_uri("obj://b/k.txt") == self.CORPUS
+        assert em.counters()["gets"] >= 1  # the tampered block refetched
+
+
+class TestAnalyzeWireEvidence:
+    def _snap(self, wire, served):
+        return {"counters": {"pagestore.hit": 0, "pagestore.miss": 8,
+                             "objstore.get": 8, "objstore.bytes": wire,
+                             "objstore.bytes_served": served}}
+
+    def test_evidence_names_compressed_and_served_rates(self):
+        from dmlc_tpu.obs.analyze import attribute
+        snap = {"wall_s": 2.0,
+                "stages": [{"name": "parse", "kind": "parse",
+                            "wait_s": 1.5, "bytes": 4_000_000_000}]}
+        v = attribute(snap, metrics=self._snap(1_000_000_000,
+                                               4_000_000_000))
+        assert v["bound"] == "wire"
+        wire_lines = [e for e in v["evidence"]
+                      if e.startswith("objstore:")]
+        assert len(wire_lines) == 1
+        assert "served from" in wire_lines[0]
+        assert "compressed wire" in wire_lines[0]
+        assert "GB/s served" in wire_lines[0]
+
+    def test_wire_heaviness_judged_on_served_bytes(self):
+        # compressed wire bytes are small; the SERVED side is what the
+        # pipeline consumed — a 4 GB epoch fed by 1 GB of wire is still
+        # wire-bound, not consumer-bound
+        from dmlc_tpu.obs.analyze import attribute
+        snap = {"wall_s": 2.0,
+                "stages": [{"name": "parse", "kind": "parse",
+                            "wait_s": 1.5, "bytes": 4_000_000_000}]}
+        v = attribute(snap, metrics=self._snap(100_000_000,
+                                               4_000_000_000))
+        assert v["bound"] == "wire"
+
+    def test_uncompressed_evidence_unchanged(self):
+        from dmlc_tpu.obs.analyze import attribute
+        snap = {"wall_s": 2.0,
+                "stages": [{"name": "parse", "kind": "parse",
+                            "wait_s": 1.5, "bytes": 1_000_000_000}]}
+        v = attribute(snap, metrics=self._snap(1_000_000_000,
+                                               1_000_000_000))
+        line = next(e for e in v["evidence"]
+                    if e.startswith("objstore:"))
+        assert "served from" not in line
+
+
+class TestCompareConfig14:
+    def _doc(self, gbps, gauges):
+        return {"config": "recio_native", "gbps": gbps, "bytes": 1,
+                "epoch_gauges": gauges}
+
+    def test_config_docs_compare_band_for_band(self):
+        from dmlc_tpu.obs.analyze import compare
+        a = self._doc(1.0, [1.2, 1.3, 1.1])   # plateau band
+        b = self._doc(0.95, [1.25, 1.2, 1.3])
+        out = compare(a, b)
+        assert out["bands"]["plateau"]["status"] == "in-band"
+        assert not out["regressions"]
+        worse = compare(a, self._doc(0.5, [1.2, 1.2, 1.2]))
+        assert worse["bands"]["plateau"]["status"] == "regression"
+
+    def test_cross_band_config_docs_incomparable(self):
+        from dmlc_tpu.obs.analyze import compare
+        out = compare(self._doc(1.0, [1.2]), self._doc(0.4, [0.5]))
+        assert all(r["status"] == "incomparable"
+                   for r in out["bands"].values())
+        assert not out["regressions"]

@@ -1,0 +1,305 @@
+"""Dense-RecordIO decode (ABI 6): the frozen payload contract, the
+Python golden parser, native/python byte parity (incl. escaped-magic
+multi-frame records and 2/4/8-way sharded parses), the fused padded
+pipeline, gang assembly, and the corruption contract (EngineError /
+DMLCError, never a crash or a silently short row)."""
+
+import hashlib
+import struct
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io.recordio import (
+    RECORDIO_MAGIC, DenseRecordWriter, decode_dense_record,
+    encode_dense_record,
+)
+from dmlc_tpu.io.stream import create_stream
+from dmlc_tpu.utils.logging import DMLCError
+
+MAGIC_F32 = np.frombuffer(struct.pack("<I", RECORDIO_MAGIC), "<f4")[0]
+
+
+def _write_dense(path, rows=600, seed=0, magic_every=17):
+    """Dense corpus with ragged rows, zero-value rows, and values whose
+    f32 bits equal the frame magic (escaped -> multi-frame records)."""
+    rng = np.random.default_rng(seed)
+    expect = []
+    with create_stream(str(path), "w") as s:
+        w = DenseRecordWriter(s)
+        for i in range(rows):
+            n = int(rng.integers(0, 40))
+            vals = rng.standard_normal(n).astype(np.float32)
+            if magic_every and i % magic_every == 0 and n >= 3:
+                vals[1] = MAGIC_F32
+            label = float(i % 5) - 2.0
+            w.write(label, vals)
+            expect.append((np.float32(label), vals))
+        escaped = w.escaped_magic_count
+    return expect, escaped
+
+
+def _stream_content(parser):
+    """Stream-invariant content digest + row count: one hash per
+    COMPONENT over the concatenated stream (block boundaries differ
+    across engines/shard counts, so per-block interleaved hashing
+    would diverge on identical content)."""
+    hs = {k: hashlib.sha256()
+          for k in ("nnz", "label", "index", "value")}
+    rows = 0
+    parser.before_first()
+    while parser.next():
+        b = parser.value()
+        hs["nnz"].update(
+            np.diff(np.asarray(b.offset)).astype("<i8").tobytes())
+        hs["label"].update(np.ascontiguousarray(b.label).tobytes())
+        hs["index"].update(
+            np.ascontiguousarray(b.index).astype("<u4").tobytes())
+        hs["value"].update(np.ascontiguousarray(b.value).tobytes())
+        rows += b.size
+    if hasattr(parser, "destroy"):
+        parser.destroy()
+    return rows, tuple(h.hexdigest() for h in hs.values())
+
+
+def _native_built():
+    from dmlc_tpu import native
+    return native.native_available()
+
+
+class TestDensePayload:
+    def test_roundtrip(self):
+        for n in (0, 1, 7, 100):
+            vals = np.linspace(-3, 3, n).astype(np.float32)
+            label, got = decode_dense_record(
+                encode_dense_record(1.25, vals))
+            assert label == np.float32(1.25)
+            assert np.array_equal(got, vals)
+
+    def test_magic_bit_value_roundtrip(self):
+        # a value whose f32 bits ARE the frame magic survives bit-exact
+        label, got = decode_dense_record(
+            encode_dense_record(0.0, [MAGIC_F32]))
+        assert got.tobytes() == struct.pack("<I", RECORDIO_MAGIC)
+
+    def test_length_contract(self):
+        payload = encode_dense_record(1.0, [1.0, 2.0])
+        with pytest.raises(DMLCError, match="disagrees"):
+            decode_dense_record(payload + b"\x00\x00\x00\x00")
+        with pytest.raises(DMLCError, match="disagrees"):
+            decode_dense_record(payload[:-4])
+        with pytest.raises(DMLCError, match="shorter"):
+            decode_dense_record(payload[:4])
+
+    def test_writer_escapes_magic(self, tmp_path):
+        _, escaped = _write_dense(tmp_path / "a.rec", rows=200,
+                                  magic_every=10)
+        assert escaped > 0  # the multi-frame decode path is exercised
+
+
+class TestPythonGolden:
+    def test_rows_decode_exactly(self, tmp_path):
+        from dmlc_tpu.data.parser import Parser
+        path = tmp_path / "g.rec"
+        expect, _ = _write_dense(path, rows=150)
+        p = Parser.create(str(path), format="recordio_dense",
+                          engine="python")
+        got = []
+        p.before_first()
+        while p.next():
+            b = p.value()
+            off = np.asarray(b.offset)
+            for i in range(b.size):
+                got.append((b.label[i],
+                            np.asarray(b.value[off[i]:off[i + 1]])))
+        assert len(got) == len(expect)
+        for (gl, gv), (el, ev) in zip(got, expect):
+            assert gl == el
+            assert np.array_equal(gv, ev)
+            # indices are the column ordinals by contract
+        if hasattr(p, "destroy"):
+            p.destroy()
+
+    def test_corrupt_payload_raises(self, tmp_path):
+        from dmlc_tpu.data.parser import Parser
+        from dmlc_tpu.io.recordio import RecordIOWriter
+        path = tmp_path / "bad.rec"
+        with create_stream(str(path), "w") as s:
+            w = RecordIOWriter(s)
+            w.write_record(struct.pack("<If", 99, 1.0) + b"\x00" * 8)
+        p = Parser.create(str(path), format="recordio_dense",
+                          engine="python")
+        with pytest.raises(DMLCError, match="disagrees"):
+            for _ in p:
+                pass
+
+    def test_split_type_guard(self, tmp_path):
+        from dmlc_tpu.data.dense_record_parser import DenseRecordParser
+        path = tmp_path / "g.rec"
+        _write_dense(path, rows=5)
+        with pytest.raises(DMLCError, match="split_type"):
+            DenseRecordParser(uri=str(path), split_type="text")
+
+
+@pytest.mark.skipif(not _native_built(), reason="native engine not built")
+class TestNativeParity:
+    def test_native_vs_python_hash(self, tmp_path):
+        from dmlc_tpu.data.parser import Parser
+        path = tmp_path / "p.rec"
+        _write_dense(path, rows=800, seed=3)
+        py = _stream_content(Parser.create(
+            str(path), format="recordio_dense", engine="python"))
+        nat = _stream_content(Parser.create(
+            str(path), format="recordio_dense", engine="native"))
+        assert py == nat and py[0] == 800
+
+    @pytest.mark.parametrize("shards", [2, 4, 8])
+    def test_sharded_parity(self, tmp_path, shards):
+        from dmlc_tpu.data.parser import Parser
+        path = tmp_path / "s.rec"
+        _write_dense(path, rows=700, seed=shards)
+        one = _stream_content(Parser.create(
+            str(path), format="recordio_dense", engine="native"))
+        many = _stream_content(Parser.create(
+            str(path), format="recordio_dense", engine="native",
+            shards=shards, chunk_size=64 << 10))
+        assert one == many
+
+    def test_native_corrupt_payload_raises(self, tmp_path):
+        from dmlc_tpu.io.recordio import RecordIOWriter
+        from dmlc_tpu.native import bindings
+        path = tmp_path / "bad.rec"
+        with create_stream(str(path), "w") as s:
+            w = RecordIOWriter(s)
+            w.write_record(encode_dense_record(1.0, [1.0]))
+            w.write_record(struct.pack("<If", 7, 0.0))  # n=7, no values
+        p = bindings.NativeDenseRecordParser(str(path))
+        with pytest.raises(DMLCError, match="disagrees"):
+            while p.next():
+                pass
+        p.destroy()
+
+    def test_truncated_file_raises(self, tmp_path):
+        from dmlc_tpu.native import bindings
+        path = tmp_path / "t.rec"
+        _write_dense(path, rows=50, magic_every=0)
+        raw = open(path, "rb").read()
+        open(path, "wb").write(raw[:-5])  # cut mid-frame
+        p = bindings.NativeDenseRecordParser(str(path))
+        with pytest.raises(DMLCError):
+            while p.next():
+                pass
+        p.destroy()
+
+
+@pytest.mark.skipif(not _native_built(), reason="native engine not built")
+class TestPaddedPipeline:
+    def _padded(self, path, engine, shards=None):
+        from dmlc_tpu.pipeline import Pipeline
+        kw = {"shards": shards} if shards else {}
+        built = (Pipeline.from_uri(str(path))
+                 .parse(format="recordio_dense", engine=engine, **kw)
+                 .batch(128, pad=True, nnz_bucket=128 * 40)
+                 .build())
+        h = hashlib.sha256()
+        n = 0
+        for b in built:
+            for k in sorted(b):
+                h.update(k.encode())
+                h.update(np.ascontiguousarray(b[k]).tobytes())
+            n += 1
+        snap = built.stats()
+        ap = next((x["assembly_path"] for s in snap["stages"]
+                   if (x := s.get("extra") or {}).get("assembly_path")),
+                  None)
+        built.close()
+        return n, h.hexdigest(), ap
+
+    def test_padded_parity_and_fusion(self, tmp_path):
+        path = tmp_path / "pp.rec"
+        _write_dense(path, rows=900, seed=9)
+        py = self._padded(path, "python")
+        nat = self._padded(path, "native")
+        sh = self._padded(path, "native", shards=2)
+        assert py[:2] == nat[:2] == sh[:2]
+        assert py[2] == "python-fused"
+        # the dense decode AND the sharded gang both lower onto the
+        # engine's padded emission — sha-identical streams, pinned
+        assert nat[2] == "native-padded"
+        assert sh[2] == "native-padded"
+
+    def test_outstanding_leak_probe(self, tmp_path):
+        # the padded lease is the ONLY live lease: arenas recycle at
+        # cut (single parser AND gang)
+        from dmlc_tpu.native import bindings
+        path = tmp_path / "lk.rec"
+        _write_dense(path, rows=400, seed=4)
+        for mk in (lambda: bindings.NativeDenseRecordParser(str(path)),
+                   lambda: bindings.NativeShardedTextParser(
+                       str(path), shards=3, format="recordio_dense")):
+            p = mk()
+            batches = 0
+            while True:
+                b = p.next_padded(64, nnz_bucket=64 * 40)
+                if b is None:
+                    break
+                batches += 1
+                assert p.outstanding() == 1, \
+                    "source arenas still leased after the cut"
+            assert batches > 1
+            lease = p.detach()
+            if lease is not None:
+                lease.release()
+            assert p.outstanding() == 0
+            p.destroy()
+
+    def test_gang_mode_guard(self, tmp_path):
+        from dmlc_tpu.native import bindings
+        path = tmp_path / "mg.rec"
+        _write_dense(path, rows=100, seed=1)
+        p = bindings.NativeShardedTextParser(
+            str(path), shards=2, format="recordio_dense")
+        assert p.next()
+        with pytest.raises(DMLCError, match="padded carry"):
+            p.next_padded(64, nnz_bucket=64 * 40)
+        # before_first resets the mode; padded then works
+        p.before_first()
+        assert p.next_padded(64, nnz_bucket=64 * 40) is not None
+        with pytest.raises(DMLCError, match="within one"):
+            p.next()
+        p.destroy()
+
+    def test_before_first_after_destroy_is_noop(self, tmp_path):
+        # regression: before_first() on a destroyed sharded parser must
+        # stay the safe no-op it was pre-gang (it used to dereference
+        # the freed gang handle in C)
+        from dmlc_tpu.native import bindings
+        path = tmp_path / "dd.rec"
+        _write_dense(path, rows=50, seed=2)
+        p = bindings.NativeShardedTextParser(
+            str(path), shards=2, format="recordio_dense")
+        assert p.next_padded(16, nnz_bucket=16 * 40) is not None
+        p.destroy()
+        p.before_first()  # must not crash
+        assert not p.next()
+        assert p.outstanding() == 0
+
+    def test_gang_epoch_restart_byte_identical(self, tmp_path):
+        from dmlc_tpu.native import bindings
+        path = tmp_path / "ep.rec"
+        _write_dense(path, rows=300, seed=6)
+        p = bindings.NativeShardedTextParser(
+            str(path), shards=2, format="recordio_dense")
+
+        def epoch():
+            p.before_first()
+            h = hashlib.sha256()
+            while True:
+                b = p.next_padded(64, nnz_bucket=64 * 40)
+                if b is None:
+                    return h.hexdigest()
+                for k in sorted(b):
+                    h.update(np.ascontiguousarray(b[k]).tobytes())
+
+        assert epoch() == epoch()
+        p.destroy()

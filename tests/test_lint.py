@@ -249,3 +249,29 @@ class TestLintGate:
         assert lint.io_seam_lint([fsys]) == []
         flight = os.path.join(lint.REPO, "dmlc_tpu", "obs", "flight.py")
         assert lint.io_seam_lint([flight]) == []
+
+    def test_codec_gate_clean(self):
+        # no direct zlib/gzip/bz2/lzma imports in dmlc_tpu/ outside
+        # io/codec.py and the pinned crc32 allowlist
+        findings = lint.codec_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_codec_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe6.py")
+        with open(bad, "w") as f:
+            f.write("import zlib\nfrom gzip import compress\n")
+        try:
+            findings = lint.codec_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 2, "\n".join(findings)
+        assert all("io/codec.py" in f for f in findings)
+
+    def test_codec_gate_exempts_codec_and_crc_allowlist(self):
+        codec = os.path.join(lint.REPO, "dmlc_tpu", "io", "codec.py")
+        assert lint.codec_lint([codec]) == []
+        # resilience/policy.py's zlib.crc32 use is pinned — but a gzip
+        # import there would NOT be covered by the crc pin
+        policy = os.path.join(lint.REPO, "dmlc_tpu", "resilience",
+                              "policy.py")
+        assert lint.codec_lint([policy]) == []

@@ -303,18 +303,19 @@ class TestShardedSingleFile:
                 c2.get_block().content_hash()
 
     def test_sharded_padded_parity(self, tmp_path):
-        # sharded parse under padded assembly: engine-level lowering
-        # needs a SINGLE parser (a padded batch may not straddle the
-        # shard boundary without changing the batch layout vs the
-        # 1-parser stream), so the stage reports the python-fused
-        # fallback — and its batches are still byte-identical to the
-        # unsharded golden because the reassembled block stream is
+        # sharded parse under padded assembly (ABI 6): the GANG handle
+        # cuts padded batches across the sub-parsers' shard-ordered
+        # arena streams in C (dtp_gang_next_padded), so the lowering
+        # fuses — assembly_path is native-padded — and batches stay
+        # byte-identical to the unsharded python golden (a batch MAY
+        # straddle the shard boundary; the gang cuts it exactly where
+        # the 1-parser stream would)
         uri = _write_libsvm(tmp_path, rows=4000, name="sp.libsvm")
         nat, nat_path = _drain_padded(uri, "native", 128, 128 * 12,
                                       chunk_size=16 << 10,
                                       parse_kw={"shards": 3})
         py, _ = _drain_padded(uri, "python", 128, 128 * 12)
-        assert nat_path == "python-fused"
+        assert nat_path == "native-padded"
         _assert_batches_equal(nat, py)
 
 
