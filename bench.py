@@ -141,6 +141,14 @@ def main() -> None:
     history_if_env()  # DMLC_TPU_HISTORY_S: /history + bundle history
     install_if_env()
     gang_if_env()     # DMLC_TPU_GANG_POLL_S (rank 0): /gang timeline
+    # the sampling profiler is DEFAULT-ON for bench runs (env still
+    # wins: DMLC_TPU_PROFILE_HZ sets the rate, =0 disables): the
+    # embedded "analysis" verdict then carries hot_frames — which
+    # FUNCTION the bound stage burns in, not just which stage
+    from dmlc_tpu.obs import profile as _profile
+    if _profile.install_if_env() is None \
+            and os.environ.get(_profile.ENV_PROFILE_HZ) is None:
+        _profile.install()
     import jax
     import numpy as np
     from dmlc_tpu.data.parser import Parser

@@ -36,6 +36,7 @@ def main() -> int:
     # rank-tagged gang trace under launch_local(trace_dir=...)
     from dmlc_tpu.obs.aggregate import install_if_env as gang_if_env
     from dmlc_tpu.obs.flight import install_if_env
+    from dmlc_tpu.obs.profile import install_if_env as prof_if_env
     from dmlc_tpu.obs.serve import serve_if_env
     from dmlc_tpu.obs.timeseries import install_if_env as hist_if_env
     from dmlc_tpu.obs.trace import trace_if_env
@@ -43,6 +44,7 @@ def main() -> int:
     hist_if_env()     # before flight: DMLC_TPU_HISTORY_S must win
     install_if_env()
     gang_if_env()     # DMLC_TPU_GANG_POLL_S (rank 0 only): /gang
+    prof_if_env()     # DMLC_TPU_PROFILE_HZ: /profile flamegraphs
     with trace_if_env():
         return _run()
 

@@ -35,10 +35,13 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                golden vs the sharded single-file parse, byte-parity
                pinned and speedup gauge-tagged (the r7 steady path)
  13. analyze — a short pipeline epoch run under the obs analysis
-               plane: the bottleneck-attribution verdict
-               (dmlc_tpu.obs.analyze, schema lint-pinned) must come
-               back non-empty and consistent with the measured stage
-               waits; the verdict rides in the JSON under "analysis"
+               plane WITH the sampling profiler installed: the
+               bottleneck-attribution verdict (dmlc_tpu.obs.analyze,
+               schema lint-pinned) must come back non-empty,
+               consistent with the measured stage waits, and carrying
+               non-empty hot_frames function-level evidence from
+               dmlc_tpu.obs.profile; the verdict rides in the JSON
+               under "analysis"
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 
@@ -1071,22 +1074,46 @@ def bench_analyze(mb: int) -> Dict:
     schema-valid (the lint-pinned VERDICT_KEYS — the same shape
     bench.py embeds and /analyze serves), non-empty, and its bound
     must be consistent with the measured stage waits (a bound naming a
-    component with zero measured wait would be fabricated evidence)."""
+    component with zero measured wait would be fabricated evidence).
+    The epoch runs under the SAMPLING PROFILER (dmlc_tpu.obs.profile,
+    high rate so even a fast epoch collects samples), so the verdict
+    must also carry non-empty, schema-valid hot_frames — the
+    function-level evidence rung below stage waits."""
     from dmlc_tpu.obs import analyze as obs_analyze
+    from dmlc_tpu.obs import profile as obs_profile
     from dmlc_tpu.obs.metrics import REGISTRY
     from dmlc_tpu.pipeline import Pipeline
 
     path = f"{_TMP}.criteo.libsvm"
-    size = make_libsvm(path, mb, seed=7, nnz_range=(25, 45),
+    # corpus floor: the epoch must span several sampler periods or the
+    # hot_frames acceptance would ride on one forced end-of-epoch
+    # sample instead of the measured epoch
+    size = make_libsvm(path, max(mb, 24), seed=7, nnz_range=(25, 45),
                        index_space=10 ** 6, real_values=True)
     built = (Pipeline.from_uri(path)
              .parse(format="libsvm", engine="auto")
              .batch(8 << 10, pad=True, nnz_bucket=(8 << 10) * 45)
              .build())
-    before = (REGISTRY.snapshot().get("counters") or {})
-    snap = built.run_epoch()
-    metrics = REGISTRY.snapshot()
-    built.close()
+    # a PRIVATE epoch-scoped sampler, never the process-global one: a
+    # suite-wide DMLC_TPU_PROFILE_HZ profiler's trie is cumulative
+    # across configs 1-12, which would rank earlier configs' frames as
+    # THIS epoch's hot_frames — the same cross-config pollution the
+    # counter delta below scopes away for the wire side
+    prof = obs_profile.StackProfiler(hz=211)
+    try:
+        # start() inside the try: a raising snapshot/epoch must not
+        # leak a 211 Hz daemon sampler into the rest of the suite
+        prof.start()
+        before = (REGISTRY.snapshot().get("counters") or {})
+        snap = built.run_epoch()
+        metrics = REGISTRY.snapshot()
+        prof.sample_now(force=True)  # even a sub-period epoch samples
+        prof_doc = prof.to_dict()
+    finally:
+        # stop() first — it never raises (a bounded thread join), so
+        # a raising close() cannot leak the 211 Hz sampler either
+        prof.stop()
+        built.close()
     # attribute() reads wire-side counters (objstore/pagestore) from
     # the snapshot — delta them across THIS epoch so an earlier
     # config's remote traffic (config 11 in a full-suite run) cannot
@@ -1096,12 +1123,20 @@ def bench_analyze(mb: int) -> Dict:
         k: (v - before[k] if isinstance(v, (int, float))
             and isinstance(before.get(k), (int, float)) else v)
         for k, v in (metrics.get("counters") or {}).items()}
-    verdict = obs_analyze.attribute(snap, metrics=metrics)
+    verdict = obs_analyze.attribute(snap, metrics=metrics,
+                                    profile_doc=prof_doc)
     assert sorted(verdict) == sorted(obs_analyze.VERDICT_KEYS), \
         f"verdict drifted from VERDICT_KEYS: {sorted(verdict)}"
     assert verdict["bound"] in obs_analyze.BOUNDS, verdict["bound"]
     assert verdict["evidence"], "empty evidence"
     assert verdict["stage_waits"]["stages"], "no per-stage waits"
+    # the profiler ran for the whole epoch: the verdict must carry
+    # function-level hot_frames evidence, schema-valid and weighted
+    assert verdict["hot_frames"], \
+        "no hot_frames from the sampling profiler"
+    for hf in verdict["hot_frames"]:
+        assert sorted(hf) == ["frac", "frame", "samples"], hf
+        assert hf["samples"] > 0 and 0.0 <= hf["frac"] <= 1.0, hf
     sw = verdict["stage_waits"]
     if verdict["bound"] in ("parse", "assemble", "xfer"):
         key = {"parse": "parse_s", "assemble": "assemble_s",
@@ -1271,6 +1306,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     # bundle if a config dies badly
     from dmlc_tpu.obs.aggregate import install_if_env as _gang_if_env
     from dmlc_tpu.obs.flight import install_if_env
+    from dmlc_tpu.obs.profile import install_if_env as _prof_if_env
     from dmlc_tpu.obs.serve import serve_if_env
     from dmlc_tpu.obs.timeseries import install_if_env as _hist_if_env
     srv = serve_if_env()
@@ -1281,6 +1317,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     _hist_if_env()
     install_if_env()
     _gang_if_env()
+    _prof_if_env()    # DMLC_TPU_PROFILE_HZ: /profile flamegraphs
     picks = [args.config] if args.config else sorted(CONFIGS)
     for n in picks:
         name, fn = CONFIGS[n]

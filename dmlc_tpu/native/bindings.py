@@ -28,7 +28,7 @@ __all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
            "NativeCSVParser", "NativeLibFMParser",
            "NativeDenseRecordParser", "NativeShardedTextParser",
            "NativeRecordIOReader", "NativeIndexedRecordIOReader",
-           "native_parse_float32", "columns_interleave"]
+           "native_parse_float32", "columns_interleave", "prof_read"]
 
 _lib = None
 
@@ -39,8 +39,11 @@ _lib = None
 # dtp_parser_stats grew to 8 slots; 6: dense RecordIO decode —
 # dtp_parser_create accepts format "recordio_dense", the frozen
 # io/recordio.py dense payload contract decoded engine-side into the
-# same arena/NextPadded machinery).
-ABI_VERSION = 6
+# same arena/NextPadded machinery; 7: phase beacons for the sampling
+# profiler — dtp_prof_read snapshots every engine worker's seqlock-
+# stamped {phase, shard} slot, dtp_parser_set_shard tags sharded
+# sub-parsers for the merged flamegraph).
+ABI_VERSION = 7
 
 
 def load(path: str):
@@ -168,6 +171,10 @@ def load(path: str):
     lib.dtp_parser_trace_drain.restype = C.c_int64
     lib.dtp_parser_trace_drain.argtypes = [
         C.c_void_p, C.POINTER(C.c_int64), C.c_int64]
+    lib.dtp_prof_read.restype = C.c_int64
+    lib.dtp_prof_read.argtypes = [C.POINTER(C.c_int64), C.c_int64]
+    lib.dtp_parser_set_shard.restype = None
+    lib.dtp_parser_set_shard.argtypes = [C.c_void_p, C.c_int32]
     _lib = lib
     # the tracing global may already be on when the engine loads late
     # (obs.trace only mirrors into an ALREADY-loaded lib)
@@ -286,6 +293,26 @@ def _native_thread_name(tid: int) -> str:
     if tid == 100:
         return "native/arena-pool"
     return f"native/worker-{tid - 2}"
+
+
+_PROF_MAX_SLOTS = 256  # engine.cc kProfSlots
+
+
+def prof_read(max_slots: int = _PROF_MAX_SLOTS):
+    """Snapshot the engine's ABI-7 phase beacons: one
+    ``(kind, index, phase, shard)`` tuple per live engine pipeline
+    thread (kind 1 = shard reader, 2 = parse worker, 3 = padded
+    consumer; phase per engine.cc ProfPhase, 0 = idle; shard -1 when
+    the parser is not a sharded sub). Returns ``[]`` when the engine
+    library is not loaded — callers (obs/profile.py's sampler) must
+    never trigger a native build/load just to profile."""
+    if _lib is None:
+        return []
+    n_slots = max(1, min(int(max_slots), _PROF_MAX_SLOTS))
+    buf = (C.c_int64 * (4 * n_slots))()
+    n = int(_lib.dtp_prof_read(buf, n_slots))
+    return [(buf[4 * i], buf[4 * i + 1], buf[4 * i + 2],
+             buf[4 * i + 3]) for i in range(n)]
 
 
 class NativeTextParser(Parser):
@@ -980,6 +1007,12 @@ class NativeShardedTextParser(Parser):
             cls(uri, j, self.shards, index_dtype=index_dtype,
                 nthreads=per, chunk_size=chunk_size, **dict(kwargs))
             for j in range(self.shards)]
+        for j, p in enumerate(self._subs):
+            # tag each sub's ABI-7 phase beacons with its shard, so
+            # the sampling profiler's merged flamegraph labels carry
+            # which shard a native worker belongs to (set BEFORE any
+            # pipeline start — StartPipeline stamps the slots)
+            p._lib.dtp_parser_set_shard(p._handle, j)
         self._cur = 0
         self._started = False
         self._block: Optional[RowBlock] = None

@@ -5,8 +5,8 @@ Compiles native/src/engine.cc into libdmlc_tpu.so next to this file
 (CMakeLists.txt, make/dmlc.mk) maps to this single-step build plus
 pyproject.toml for the Python side.
 
-The build ASSERTS the compiled engine's ABI (``dtp_version()``, 6
-since the dense-RecordIO decode) equals ``bindings.ABI_VERSION`` in a
+The build ASSERTS the compiled engine's ABI (``dtp_version()``, 7
+since the profiler phase beacons) equals ``bindings.ABI_VERSION`` in a
 subprocess probe — a stale source tree or .so fails the BUILD loudly
 instead of engine="auto" callers silently falling back to the python
 golden at first use.

@@ -2193,6 +2193,86 @@ inline bool trace_on() {
   return g_trace_on.load(std::memory_order_relaxed) != 0;
 }
 
+// --------------------------------------------------- phase beacons
+// ABI-7 sampling-profiler surface: every engine pipeline thread
+// (shard reader, parse-pool worker, padded-assembly consumer) keeps
+// one seqlock-stamped {phase, shard} slot in a process-global table,
+// read by the Python sampler (obs/profile.py) through dtp_prof_read
+// at its tick rate. Unlike the span ring this is STATE, not events:
+// the sampler wants "what is this thread doing right now", so a
+// beacon write is two relaxed stores + the payload (per chunk/batch,
+// not per row) and reading never blocks a writer. The engine's
+// threads are invisible to sys._current_frames — this table is the
+// only thing that lets one flamegraph span the GIL boundary.
+
+enum ProfPhase : int32_t {
+  kPhaseIdle = 0,          // not in the run (sampler skips the slot)
+  kPhaseRead = 1,          // reader: inside NextChunk/NextChunkView
+  kPhaseReaderWait = 2,    // reader: blocked pushing the chunk queue
+  kPhaseParse = 3,         // worker: inside ParseChunkInto
+  kPhaseWorkerWait = 4,    // worker: blocked on chunk pop/block push
+  kPhaseAssemble = 5,      // consumer: padded-batch copy (one parser)
+  kPhaseGangAssemble = 6,  // consumer: cross-shard padded copy (gang)
+};
+
+enum ProfKind : int32_t {
+  kProfFree = 0,
+  kProfReader = 1,
+  kProfWorker = 2,
+  kProfConsumer = 3,
+};
+
+struct ProfSlot {
+  std::atomic<uint32_t> seq{0};  // seqlock: odd while a writer owns it
+  std::atomic<int32_t> kind{0};  // kProfFree = unclaimed
+  // payload fields are atomics with RELAXED ops (same cost as plain
+  // stores on every target here): the seqlock already rejects torn
+  // READS, but a plain field written concurrently with dtp_prof_read
+  // would still be a C++ data race — and this codebase's concurrency
+  // is TSAN-clean by contract
+  std::atomic<int32_t> index{0};   // worker ordinal within the parser
+  std::atomic<int32_t> shard{-1};  // dtp_parser_set_shard tag
+  std::atomic<int32_t> phase{kPhaseIdle};
+};
+
+constexpr int kProfSlots = 256;
+ProfSlot g_prof_slots[kProfSlots];
+std::mutex g_prof_mu;  // claim/release only; phase writes are lock-free
+
+int prof_claim(int32_t kind, int32_t index, int32_t shard) {
+  std::lock_guard<std::mutex> lk(g_prof_mu);
+  for (int i = 0; i < kProfSlots; ++i) {
+    ProfSlot& s = g_prof_slots[i];
+    if (s.kind.load(std::memory_order_relaxed) != kProfFree) continue;
+    uint32_t q = s.seq.load(std::memory_order_relaxed);
+    s.seq.store(q + 1, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_release);
+    s.index.store(index, std::memory_order_relaxed);
+    s.shard.store(shard, std::memory_order_relaxed);
+    s.phase.store(kPhaseIdle, std::memory_order_relaxed);
+    s.seq.store(q + 2, std::memory_order_release);
+    s.kind.store(kind, std::memory_order_release);
+    return i;
+  }
+  return -1;  // table full: beacons degrade, parsing does not
+}
+
+void prof_release(int slot) {
+  if (slot < 0) return;
+  std::lock_guard<std::mutex> lk(g_prof_mu);
+  g_prof_slots[slot].kind.store(kProfFree, std::memory_order_release);
+}
+
+inline void prof_set_phase(int slot, int32_t phase) {
+  if (slot < 0) return;
+  ProfSlot& s = g_prof_slots[slot];
+  uint32_t q = s.seq.load(std::memory_order_relaxed);
+  s.seq.store(q + 1, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  s.phase.store(phase, std::memory_order_relaxed);
+  s.seq.store(q + 2, std::memory_order_release);
+}
+
 template <typename T>
 class BoundedQueue {
  public:
@@ -2475,7 +2555,9 @@ int64_t NextPaddedImpl(PaddedPlane& P, NextArenaFn next_arena,
                        SpanRing* ring, std::string* error,
                        int64_t rows_per_batch, int64_t row_bucket,
                        int64_t nnz_bucket, bool want_qid,
-                       bool want_field, PaddedBlock** out) {
+                       bool want_field, PaddedBlock** out,
+                       int prof_slot = -1,
+                       int32_t prof_assemble = kPhaseAssemble) {
   if (rows_per_batch < 1 || row_bucket < rows_per_batch ||
       nnz_bucket < 0) {
     *error = "padded batch: need 1 <= rows_per_batch <= row_bucket";
@@ -2508,6 +2590,10 @@ int64_t NextPaddedImpl(PaddedPlane& P, NextArenaFn next_arena,
   while (r < rows_per_batch) {
     if (!P.carry) {
       if (P.eof) break;
+      // the pop-wait is the PARSE side's time (the Python pull span /
+      // the sub-parsers' own beacons own it): the consumer beacon
+      // goes idle so sampled assemble share is copy time only
+      prof_set_phase(prof_slot, kPhaseIdle);
       int64_t rows = next_arena(&P.carry, &P.carry_origin);
       if (rows < 0) {
         recycle_pb();
@@ -2519,6 +2605,7 @@ int64_t NextPaddedImpl(PaddedPlane& P, NextArenaFn next_arena,
       }
       P.carry_row = 0;
     }
+    prof_set_phase(prof_slot, prof_assemble);
     int64_t t0 = now_ns();
     if (!t_first) t_first = t0;
     CSRArena* a = P.carry.get();
@@ -2531,6 +2618,7 @@ int64_t NextPaddedImpl(PaddedPlane& P, NextArenaFn next_arena,
                " exceeds nnz_bucket " + std::to_string(nnz_bucket) +
                " (nnz bucket too small)";
       recycle_pb();
+      prof_set_phase(prof_slot, kPhaseIdle);
       return -1;
     }
     // offset: rebase the slice by a constant delta
@@ -2613,8 +2701,10 @@ int64_t NextPaddedImpl(PaddedPlane& P, NextArenaFn next_arena,
   }
   if (r == 0) {
     recycle_pb();
+    prof_set_phase(prof_slot, kPhaseIdle);
     return 0;  // clean end of stream
   }
+  prof_set_phase(prof_slot, prof_assemble);
   int64_t t0 = now_ns();
   if (!t_first) t_first = t0;
   // neutral pad tails — the exact values the Python fused path writes
@@ -2658,6 +2748,7 @@ int64_t NextPaddedImpl(PaddedPlane& P, NextArenaFn next_arena,
     // ride on the Python pull span)
     ring->Record(kTraceBatchAssemble, kTidConsumer, t_first, batch_ns,
                  r);
+  prof_set_phase(prof_slot, kPhaseIdle);
   *out = P.Lease(std::move(pb));
   return r;
 }
@@ -2706,6 +2797,15 @@ struct ParserHandle {
   // the consumer never holds an arena on the padded path).
   PaddedPlane plane;
   int64_t last_pop_ns = 0;  // trace anchor: set after a successful pop
+
+  // ABI-7 phase beacons: one slot per pipeline thread + the consumer,
+  // claimed at StartPipeline / released at StopPipeline (after joins,
+  // so no thread can stamp a freed slot). prof_shard tags sharded
+  // sub-parsers (dtp_parser_set_shard) for the merged flamegraph.
+  int32_t prof_shard = -1;
+  int prof_reader_slot = -1;
+  int prof_consumer_slot = -1;
+  std::vector<int> prof_worker_slots;
 
   std::unique_ptr<CSRArena> GetArena() {
     std::unique_ptr<CSRArena> a;
@@ -2759,6 +2859,12 @@ struct ParserHandle {
     reader_thread.reset();
     chunks.reset();
     blocks.reset();
+    // beacons release AFTER the joins: no thread left to stamp them
+    prof_release(prof_reader_slot);
+    prof_release(prof_consumer_slot);
+    for (int s : prof_worker_slots) prof_release(s);
+    prof_worker_slots.clear();
+    prof_reader_slot = prof_consumer_slot = -1;
   }
 
   void StartPipeline() {
@@ -2769,13 +2875,23 @@ struct ParserHandle {
     chunks = std::make_unique<BoundedQueue<ChunkItem>>(window);
     // producers = nthreads workers + the reader (for its error slot)
     blocks = std::make_unique<OrderedQueue>(window, nthreads + 1);
+    // phase beacons claimed BEFORE the threads exist, so the lambdas
+    // below read stable slot ids (released in StopPipeline)
+    prof_reader_slot = prof_claim(kProfReader, 0, prof_shard);
+    prof_consumer_slot = prof_claim(kProfConsumer, 0, prof_shard);
+    prof_worker_slots.clear();
+    for (int w = 0; w < nthreads; ++w)
+      prof_worker_slots.push_back(prof_claim(kProfWorker, w,
+                                             prof_shard));
 
     reader_thread = std::make_unique<std::thread>([this] {
+      const int rslot = prof_reader_slot;
       uint64_t seq = 0;
       try {
         bool try_views = true;  // mmap fast path until a file declines
         while (true) {
           ChunkItem item;
+          prof_set_phase(rslot, kPhaseRead);
           int64_t t0 = now_ns();
           bool more;
           if (try_views) {
@@ -2798,6 +2914,7 @@ struct ParserHandle {
                         (int64_t)seq);
           item.seq = seq++;
           stats.chunks += 1;
+          prof_set_phase(rslot, kPhaseReaderWait);
           if (!chunks->Push(std::move(item))) break;
         }
         chunks->Finish();
@@ -2808,13 +2925,18 @@ struct ParserHandle {
         chunks->Finish();
         blocks->Push(seq, {nullptr, std::string(ex.what())});
       }
+      prof_set_phase(rslot, kPhaseIdle);
       blocks->ProducerDone();
     });
 
     for (int w = 0; w < nthreads; ++w) {
       pool.emplace_back([this, w] {
+        const int pslot = prof_worker_slots[w];
         ChunkItem item;
-        while (chunks->Pop(&item)) {
+        for (;;) {
+          prof_set_phase(pslot, kPhaseWorkerWait);
+          if (!chunks->Pop(&item)) break;
+          prof_set_phase(pslot, kPhaseParse);
           BlockItem out;
           int64_t t0 = now_ns();
           int64_t c0 = thread_cpu_ns();
@@ -2848,8 +2970,10 @@ struct ParserHandle {
             ring.Record(kTraceTokenize, kTidWorker0 + w, t0, t1 - t0,
                         (int64_t)item.seq);
           if (!item.view) RecycleChunkBuf(std::move(item.data));
+          prof_set_phase(pslot, kPhaseWorkerWait);
           if (!blocks->Push(item.seq, std::move(out))) break;
         }
+        prof_set_phase(pslot, kPhaseIdle);
         blocks->ProducerDone();
       });
     }
@@ -2964,7 +3088,8 @@ struct ParserHandle {
     };
     return NextPaddedImpl(plane, next, recycle, &stats, &ring, &error,
                           rows_per_batch, row_bucket, nnz_bucket,
-                          want_qid, want_field, out);
+                          want_qid, want_field, out,
+                          prof_consumer_slot, kPhaseAssemble);
   }
 
   // End-of-stream pool trim. The per-parser free lists exist to recycle
@@ -3073,6 +3198,7 @@ struct RecordIOHandle {
   std::atomic<bool> reader_failed{false};
   std::string error;
   PipelineStats stats;
+  int prof_reader_slot = -1;  // ABI-7 beacon: this reader thread too
 
   RecBatchPool pool;
   RecBatch* last = nullptr;
@@ -3084,6 +3210,8 @@ struct RecordIOHandle {
     if (reader_thread && reader_thread->joinable()) reader_thread->join();
     reader_thread.reset();
     chunks.reset();
+    prof_release(prof_reader_slot);  // after the join, like ParserHandle
+    prof_reader_slot = -1;
   }
 
   void StartPipeline() {
@@ -3092,11 +3220,14 @@ struct RecordIOHandle {
     stats.Reset();
     reader_failed = false;
     chunks = std::make_unique<BoundedQueue<ChunkItem>>(4);
+    prof_reader_slot = prof_claim(kProfReader, 0, -1);
     reader_thread = std::make_unique<std::thread>([this] {
+      const int rslot = prof_reader_slot;
       try {
         bool try_views = true;  // mmap fast path until a file declines
         while (true) {
           ChunkItem item;
+          prof_set_phase(rslot, kPhaseRead);
           int64_t t0 = now_ns();
           bool more;
           if (try_views) {
@@ -3114,7 +3245,11 @@ struct RecordIOHandle {
           stats.reader_busy_ns += now_ns() - t0;
           if (!more) break;
           stats.chunks += 1;
-          if (!chunks->Push(std::move(item))) return;
+          prof_set_phase(rslot, kPhaseReaderWait);
+          if (!chunks->Push(std::move(item))) {
+            prof_set_phase(rslot, kPhaseIdle);
+            return;
+          }
         }
       } catch (const EngineError& err) {
         reader_error = err.msg;
@@ -3123,6 +3258,7 @@ struct RecordIOHandle {
         reader_error = ex.what();
         reader_failed = true;
       }
+      prof_set_phase(rslot, kPhaseIdle);
       chunks->Finish();
     });
   }
@@ -3338,8 +3474,12 @@ const char* dtp_last_error() { return g_last_error.c_str(); }
 //     assemble_ns/before_first/destroy) — a pre-6 .so silently lacks
 //     both, so the version bump makes a stale engine fail LOUDLY at
 //     load/build instead of at first dense parse.
+// 7 = per-worker phase beacons for the sampling profiler
+//     (dtp_prof_read next to the busy-ns counters; dtp_parser_set_shard
+//     tags sharded sub-parsers): the obs/profile.py sampler folds the
+//     engine's reader/parse/assemble phases into the merged flamegraph.
 // Bump on ANY signature change — bindings.load() refuses mismatches.
-int dtp_version() { return 6; }
+int dtp_version() { return 7; }
 
 // ------------------------------------------------------------- tracing
 
@@ -3368,6 +3508,46 @@ int64_t dtp_parser_trace_drain(void* handle, int64_t* out,
                                int64_t max_events) {
   auto* h = static_cast<ParserHandle*>(handle);
   return h->ring.Drain(out, max_events);
+}
+
+// ------------------------------------------------- profiling beacons
+
+// ABI-7 sampler read: snapshot every claimed phase beacon into `out`
+// as 4 int64 per slot — [kind, index, phase, shard] (ProfKind /
+// ProfPhase above). Seqlock-consistent: a slot caught mid-write (or
+// re-stamped between the paired seq loads) is skipped this tick, never
+// torn. Wait-free for the engine threads; call rate is the Python
+// sampler's hz. Returns the slot count written.
+int64_t dtp_prof_read(int64_t* out, int64_t max_slots) {
+  int64_t n = 0;
+  for (int i = 0; i < kProfSlots && n < max_slots; ++i) {
+    ProfSlot& s = g_prof_slots[i];
+    int32_t kind = s.kind.load(std::memory_order_acquire);
+    if (kind == kProfFree) continue;
+    uint32_t q1 = s.seq.load(std::memory_order_acquire);
+    if (q1 & 1) continue;  // writer owns the slot right now
+    int32_t index = s.index.load(std::memory_order_relaxed);
+    int32_t shard = s.shard.load(std::memory_order_relaxed);
+    int32_t phase = s.phase.load(std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (s.seq.load(std::memory_order_relaxed) != q1) continue;
+    if (s.kind.load(std::memory_order_relaxed) != kind) continue;
+    out[n * 4 + 0] = kind;
+    out[n * 4 + 1] = index;
+    out[n * 4 + 2] = phase;
+    out[n * 4 + 3] = shard;
+    ++n;
+  }
+  return n;
+}
+
+// Tag a parser's beacon slots with a shard ordinal (sharded
+// single-file parse: bindings call this per sub-parser right after
+// create, BEFORE the pipeline starts) so the merged flamegraph's
+// thread labels carry which shard a native worker belongs to.
+void dtp_parser_set_shard(void* handle, int32_t shard) {
+  if (!handle) return;
+  static_cast<ParserHandle*>(handle)->prof_shard = shard;
 }
 
 // files: paths array; sizes must match the Python VFS listing so the
@@ -3651,6 +3831,7 @@ struct GangHandle {
   PaddedPlane plane;
   PipelineStats stats;              // assemble_ns only (subs own I/O)
   std::string error;
+  int prof_slot = -1;               // ABI-7 gang-consumer beacon
 
   int64_t NextPadded(int64_t rows_per_batch, int64_t row_bucket,
                      int64_t nnz_bucket, bool want_qid, bool want_field,
@@ -3678,7 +3859,8 @@ struct GangHandle {
     return NextPaddedImpl(plane, next, recycle, &stats,
                           subs.empty() ? nullptr : &subs.front()->ring,
                           &error, rows_per_batch, row_bucket,
-                          nnz_bucket, want_qid, want_field, out);
+                          nnz_bucket, want_qid, want_field, out,
+                          prof_slot, kPhaseGangAssemble);
   }
 
   void BeforeFirst() {
@@ -3700,6 +3882,9 @@ void* dtp_gang_create(void** parser_handles, int64_t n) {
   auto g = std::make_unique<GangHandle>();
   for (int64_t i = 0; i < n; ++i)
     g->subs.push_back(static_cast<ParserHandle*>(parser_handles[i]));
+  // the gang's cross-shard assembly runs on the caller thread: its
+  // beacon lives as long as the gang (idle outside NextPadded)
+  g->prof_slot = prof_claim(kProfConsumer, 0, -1);
   return g.release();
 }
 
@@ -3767,6 +3952,8 @@ void dtp_gang_before_first(void* gang) {
 }
 
 void dtp_gang_destroy(void* gang) {
+  if (!gang) return;
+  prof_release(static_cast<GangHandle*>(gang)->prof_slot);
   delete static_cast<GangHandle*>(gang);
 }
 

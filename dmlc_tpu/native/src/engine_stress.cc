@@ -8,6 +8,8 @@
 //  - before_first replay while the previous pipeline is mid-flight
 //  - lease release from a DIFFERENT thread than the consumer
 //  - the recordio reader's chunk queue + buffer recycling
+//  - the ABI-7 phase beacons: a sampler-shaped dtp_prof_read poller
+//    racing every scenario's claim/stamp/release traffic
 //
 // Exit 0 + no TSAN report = clean. Scenario sizes are small so the whole
 // run stays a few seconds even under TSAN's ~10x slowdown.
@@ -176,10 +178,25 @@ int main() {
   if (std::system(mk.c_str()) != 0) return 2;
   std::string svm = write_libsvm(dir + "/s.libsvm", 20000);
   std::string rec = write_recordio(dir + "/s.rec", 2000);
+  // the Python sampler's shape: hammer the phase-beacon snapshot while
+  // every scenario claims, stamps, and releases slots under it
+  std::atomic<bool> prof_done{false};
+  std::thread prof_poller([&] {
+    int64_t buf[4 * 256];
+    int64_t sink = 0;
+    while (!prof_done.load()) {
+      int64_t n = dtp_prof_read(buf, 256);
+      for (int64_t i = 0; i < n; ++i) sink += buf[4 * i + 2];
+    }
+    volatile int64_t keep = sink;
+    (void)keep;
+  });
   scenario_epochs(svm);
   scenario_midstream_kill(svm);
   scenario_cross_thread_release(svm);
   scenario_recordio(rec);
+  prof_done = true;
+  prof_poller.join();
   std::printf("engine stress scenarios completed\n");
   return 0;
 }

@@ -29,7 +29,12 @@ Python and native layers (docs/observability.md):
   unreachable-rank gaps; served at /gang);
 - :mod:`~dmlc_tpu.obs.analyze` — bottleneck attribution (the
   structured bound verdict bench.py embeds and /analyze serves) and
-  band-aware BENCH-to-BENCH regression comparison.
+  band-aware BENCH-to-BENCH regression comparison;
+- :mod:`~dmlc_tpu.obs.profile` — the continuous sampling profiler:
+  merged Python+native flamegraphs (sys._current_frames + the
+  engine's phase beacons) in a byte-budgeted coarsening trie, served
+  at /profile, attached to stall reports and crash bundles, and the
+  ``hot_frames`` evidence in the analyze verdict.
 """
 
 from dmlc_tpu.obs.aggregate import GangAggregator
@@ -38,6 +43,7 @@ from dmlc_tpu.obs.export import (
     chrome_events, merge_chrome_files, write_chrome,
 )
 from dmlc_tpu.obs.flight import FlightRecorder
+from dmlc_tpu.obs.profile import FrameTrie, StackProfiler
 from dmlc_tpu.obs.timeseries import TimeSeriesRing
 from dmlc_tpu.obs.log import warn_limited, warn_once
 from dmlc_tpu.obs.metrics import (
@@ -64,4 +70,5 @@ __all__ = [
     "FlightRecorder",
     "TimeSeriesRing", "GangAggregator",
     "attribute", "compare", "gauge_band",
+    "StackProfiler", "FrameTrie",
 ]

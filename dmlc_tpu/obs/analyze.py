@@ -9,9 +9,17 @@ records — ``StageProbe`` wait totals, the device stage's
 ``assemble_s``, pagestore/objstore hit counters, and the credit-gauge
 bands bench.py computes — into one structured verdict:
 
-``{"schema": 1, "bound": "parse" | "assemble" | "xfer" | "wire" |
+``{"schema": 2, "bound": "parse" | "assemble" | "xfer" | "wire" |
 "credit-limited" | "consumer", "band": <credit band>, "confidence":
-"high" | "medium" | "low", "evidence": [...], "stage_waits": {...}}``
+"high" | "medium" | "low", "evidence": [...], "hot_frames": [...],
+"stage_waits": {...}}``
+
+``hot_frames`` (schema 2) is function-level evidence from the
+sampling profiler (:mod:`dmlc_tpu.obs.profile`) when one is
+installed: the top on-CPU frames whose call path matches the bound
+component — the first rung below stage granularity, "parse-bound"
+becomes "parse-bound, and it is THIS function". Empty when no
+profiler runs (the verdict says which stage, not which frame).
 
 The key set is pinned by ``scripts/lint.py``'s verdict-schema gate (a
 literal-dict key check like the metric-name gate), so the ``/analyze``
@@ -38,12 +46,13 @@ __all__ = ["attribute", "compare", "compare_files", "load_bench",
            "ANALYSIS_SCHEMA", "DEFAULT_TOLERANCE"]
 
 # bump when the verdict's top-level shape changes incompatibly
-ANALYSIS_SCHEMA = 1
+# (2: hot_frames — sampling-profiler function-level evidence)
+ANALYSIS_SCHEMA = 2
 
 # the verdict's pinned key set — scripts/lint.py's verdict-schema gate
 # checks every literal verdict dict in the package against this tuple
 VERDICT_KEYS = ("schema", "bound", "band", "confidence", "evidence",
-                "stage_waits")
+                "hot_frames", "stage_waits")
 
 BOUNDS = ("parse", "assemble", "xfer", "wire", "credit-limited",
           "consumer")
@@ -91,10 +100,61 @@ def _counter(metrics: Optional[Dict[str, Any]], name: str) -> float:
     return float(v) if isinstance(v, (int, float)) else 0.0
 
 
+# call-path substrings that tie a sampled frame to a bound component:
+# hot_frames for bound=X keeps frames whose path matches X's hints
+# (falling back to the overall top when nothing matches — an honest
+# "hottest frames overall" beats fabricated stage attribution)
+_BOUND_FRAME_HINTS = {
+    "parse": ("native:parse", "native:read", "parser", "parse",
+              "tokenize", "strtonum", "recordio", "input_split"),
+    "assemble": ("native:assemble", "native:gang_assemble", "padding",
+                 "assemble", "stack_padded", "pad_to_bucket",
+                 "pad_single"),
+    "xfer": ("device", "xfer", "transfer", "staging", "backends"),
+    "wire": ("objstore", "urlopen", "http", "emulator", "pagestore"),
+}
+
+
+def _hot_frames_for(bound: str,
+                    profile_doc: Optional[Dict[str, Any]] = None,
+                    limit: int = 8
+                    ) -> Tuple[List[Dict[str, Any]], str]:
+    """Top on-CPU frames of the bound component, from an explicit
+    profile ``to_dict()`` payload or the process's installed sampling
+    profiler. Returns ``(frames, scope)`` — scope "bound" when the
+    frames actually matched the bound's hints, "overall" when the
+    bound HAS no frame vocabulary (consumer/credit-limited), and
+    "fallback" when hints existed but nothing matched (the evidence
+    line must SAY which, or the fallback fabricates the very stage
+    attribution it exists to avoid). ``([], "bound")`` when nothing
+    was sampled at all."""
+    if profile_doc is None:
+        try:
+            from dmlc_tpu.obs import profile as _prof
+            p = _prof.active()
+            profile_doc = p.to_dict() if p is not None else None
+        except Exception:  # noqa: BLE001 — evidence is optional
+            profile_doc = None
+    if not profile_doc or not profile_doc.get("samples"):
+        return [], "bound"
+    from dmlc_tpu.obs.profile import hot_frames
+    hints = _BOUND_FRAME_HINTS.get(bound)
+    if hints is None:
+        return hot_frames(profile_doc, hints=None, limit=limit), \
+            "overall"
+    out = hot_frames(profile_doc, hints=hints, limit=limit)
+    if out:
+        return out, "bound"
+    return hot_frames(profile_doc, hints=None, limit=limit), \
+        "fallback"
+
+
 def attribute(pipeline_snap: Dict[str, Any],
               metrics: Optional[Dict[str, Any]] = None,
               epoch_gauges: Optional[List[float]] = None,
-              run_band: Optional[str] = None) -> Dict[str, Any]:
+              run_band: Optional[str] = None,
+              profile_doc: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, Any]:
     """Decompose one epoch into a bound verdict.
 
     ``pipeline_snap`` is a pipeline stats snapshot
@@ -104,7 +164,10 @@ def attribute(pipeline_snap: Dict[str, Any],
     snapshot for the wire-side counters (pagestore/objstore hit
     rates). ``epoch_gauges``/``run_band`` carry bench.py's credit
     gauges when available — without them the credit-limited bound
-    cannot be claimed and the verdict says so.
+    cannot be claimed and the verdict says so. ``profile_doc`` is an
+    optional :mod:`dmlc_tpu.obs.profile` ``to_dict()`` payload for
+    the ``hot_frames`` evidence; when omitted, the process's
+    installed sampling profiler (if any) is read.
     """
     stages = list(pipeline_snap.get("stages") or [])
     wall = float(pipeline_snap.get("wall_s") or 0.0)
@@ -246,12 +309,24 @@ def attribute(pipeline_snap: Dict[str, Any],
             evidence.append(
                 f"close call: {ranked[0][0]} {round(top_s, 4)}s vs "
                 f"{ranked[1][0]} {round(second_s, 4)}s")
+    hot, hot_scope = _hot_frames_for(bound, profile_doc)
+    if hot:
+        label = {"bound": f"hot frames ({bound})",
+                 "overall": "hot frames (overall)",
+                 "fallback": f"hot frames (overall — no sampled "
+                             f"frame matched the {bound} stage)"
+                 }[hot_scope]
+        evidence.append(
+            f"{label}: "
+            + ", ".join(f"{h['frame']} {h['frac']:.0%}"
+                        for h in hot[:3]))
     return {
         "schema": ANALYSIS_SCHEMA,
         "bound": bound,
         "band": band,
         "confidence": confidence,
         "evidence": evidence,
+        "hot_frames": hot,
         "stage_waits": {
             "parse_s": round(parse_s, 6),
             "assemble_s": round(assemble_s, 6),

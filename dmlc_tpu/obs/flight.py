@@ -39,6 +39,9 @@ Bundle layout (one timestamped dir per process under ``out_dir``)::
       env.json        # argv, python, platform, DMLC_*/JAX_* env
       error.txt       # the traceback (exception dumps)
       fatal.txt       # faulthandler output (fatal-signal deaths)
+      profile.txt     # sampling profiler's collapsed stacks — forced
+                      # final sample + everything accumulated (only
+                      # when dmlc_tpu.obs.profile is installed)
       faults.json     # armed fault plan + injected-fault log (only
                       # when dmlc_tpu.resilience.inject chaos was on)
 
@@ -295,6 +298,22 @@ class FlightRecorder:
             })
             if history is not None:
                 _write_json("history.json", history)
+            # the sampling profiler's collapsed stacks (forced sample
+            # first — the period bypass — so even a fresh profiler
+            # carries the dying state): absent when none is installed,
+            # so clean/unprofiled runs leave nothing extra
+            try:
+                from dmlc_tpu.obs import profile as _prof
+                prof_lines = _prof.dump_collapsed()
+            except Exception:  # noqa: BLE001 — optional section
+                prof_lines = None
+            if prof_lines is not None:
+                try:
+                    with open(os.path.join(d, "profile.txt"), "w") as f:
+                        f.write("\n".join(prof_lines) + "\n")
+                    wrote["profile.txt"] = "ok"
+                except Exception as e:  # noqa: BLE001
+                    wrote["profile.txt"] = f"failed: {e!r}"
             try:
                 from dmlc_tpu.resilience import inject as _inject
                 plan = _inject.active()

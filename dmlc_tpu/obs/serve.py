@@ -31,7 +31,12 @@ rank serves:
   rollups, explicit unreachable-rank gaps;
 - ``GET /analyze`` — a bottleneck-attribution verdict
   (:mod:`dmlc_tpu.obs.analyze`) over the last completed pipeline
-  epoch's stage stats + the current registry snapshot.
+  epoch's stage stats + the current registry snapshot;
+- ``GET /profile[?seconds=N&hz=M]`` — the sampling profiler's merged
+  Python+native flamegraph (:mod:`dmlc_tpu.obs.profile`): the
+  continuous trie, or an on-demand burst capture of the next N
+  seconds at M Hz (404 with an enable hint when no profiler is
+  installed, like ``/history``).
 
 ``launch_local(serve_ports=[...])`` hands every worker a port via
 ``DMLC_TPU_SERVE_PORT`` (workers opt in with one :func:`serve_if_env`
@@ -328,6 +333,29 @@ class _Handler(BaseHTTPRequestHandler):
                         code=404)
                 else:
                     self._send_json(verdict)
+            elif url.path == "/profile":
+                from dmlc_tpu.obs import profile as _prof
+                prof = _prof.active()
+                if prof is None:
+                    self._send_json(
+                        {"error": "no sampling profiler installed",
+                         "hint": "set DMLC_TPU_PROFILE_HZ (launch_"
+                                 "local(profile_hz=...)) or call "
+                                 "obs.profile.install()"},
+                        code=404)
+                else:
+                    q = parse_qs(url.query)
+                    raw_s = q.get("seconds", [None])[0]
+                    raw_hz = q.get("hz", [None])[0]
+                    if raw_s is None:
+                        self._send_json(prof.to_dict())
+                    else:
+                        # the handler thread sleeps for the burst
+                        # window — same clamp as /trace?seconds=N
+                        seconds = max(0.0, min(float(raw_s),
+                                               MAX_TRACE_CAPTURE_S))
+                        hz = float(raw_hz) if raw_hz else None
+                        self._send_json(prof.burst(seconds, hz=hz))
             else:
                 self._send_json({"error": "unknown endpoint",
                                  "endpoints": ["/metrics",
@@ -335,7 +363,9 @@ class _Handler(BaseHTTPRequestHandler):
                                                "/healthz", "/stacks",
                                                "/trace?seconds=N",
                                                "/history", "/gang",
-                                               "/analyze"]},
+                                               "/analyze",
+                                               "/profile?seconds=N"
+                                               "&hz=M"]},
                                 code=404)
         except Exception as e:  # noqa: BLE001 — a scrape must never
             try:                # take down the serving thread

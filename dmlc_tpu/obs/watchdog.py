@@ -15,7 +15,10 @@ tier), a full metrics-registry snapshot (spill state, engine stats —
 whatever the process registered), the trailing ``history_s`` of
 time-series samples when the shared :mod:`dmlc_tpu.obs.timeseries`
 ring is installed (the decay INTO the stall, not just the frozen end
-state), and ``faulthandler`` stacks of every thread. The report lands as JSON at ``report_path`` (plus a warning
+state), the sampling profiler's collapsed stacks when
+:mod:`dmlc_tpu.obs.profile` is installed (a forced sample first, so
+the report carries the stalling state itself), and ``faulthandler``
+stacks of every thread. The report lands as JSON at ``report_path`` (plus a warning
 through obs.log) and in ``self.reports`` for tests/tooling.
 """
 
@@ -226,6 +229,14 @@ class Watchdog:
                 history = ring.last(self.history_s)
         except Exception:  # noqa: BLE001 — diagnostics must not raise
             history = []
+        # the sampling profiler's collapsed stacks (forced sample, the
+        # period bypass): WHERE the process is burning/blocking as it
+        # stalls — None when no profiler is installed
+        try:
+            from dmlc_tpu.obs import profile as _prof
+            prof_lines = _prof.dump_collapsed()
+        except Exception:  # noqa: BLE001 — diagnostics must not raise
+            prof_lines = None
         return {
             "kind": "dmlc_tpu_stall_report",
             "time": time.time(),
@@ -235,6 +246,7 @@ class Watchdog:
             "metrics": metrics,
             "history": history,
             "history_s": self.history_s,
+            "profile": prof_lines,
             "stacks": _thread_stacks(),
         }
 

@@ -54,6 +54,8 @@ ENV_FLIGHT_DIR = "DMLC_TPU_FLIGHT_DIR"    # crash-bundle output dir
 # obs.timeseries.install_if_env() and obs.aggregate.install_if_env()
 ENV_HISTORY_S = "DMLC_TPU_HISTORY_S"      # time-series sample period
 ENV_GANG_POLL_S = "DMLC_TPU_GANG_POLL_S"  # rank-0 gang-poll period
+ENV_PROFILE_HZ = "DMLC_TPU_PROFILE_HZ"    # sampling-profiler rate
+#   (launch_local(profile_hz=...); obs.profile.install_if_env())
 # resilience contracts (dmlc_tpu.resilience): launch_local(faults=...)
 # sets DMLC_TPU_FAULTS for every member; the gang supervisor sets
 # DMLC_TPU_ATTEMPT (alias DMLC_NUM_ATTEMPT — the reference's rejoin
@@ -206,6 +208,7 @@ def launch_local(num_workers: int, command: Sequence[str],
                  flight_dir: Optional[str] = None,
                  history_s: Optional[float] = None,
                  gang_poll_s: Optional[float] = None,
+                 profile_hz: Optional[float] = None,
                  restart_policy=None,
                  faults=None) -> List[int]:
     """Run N worker processes on this host (reference: local.py).
@@ -272,6 +275,13 @@ def launch_local(num_workers: int, command: Sequence[str],
     ``/metrics.json`` at that period into one gang timeline (per-rank
     series + sum/min/max rollups + explicit unreachable gaps), served
     at rank 0's ``/gang``.
+
+    ``profile_hz`` hands every worker the sampling-profiler contract
+    (``DMLC_TPU_PROFILE_HZ``): workers that call
+    ``obs.profile.install_if_env()`` run the continuous sampler at
+    that rate — merged Python+native flamegraphs served at
+    ``/profile``, attached to stall reports and crash bundles
+    (``profile.txt``), and feeding ``hot_frames`` verdict evidence.
 
     Returns the list of exit codes (workers first in task-id order,
     then scheduler, then servers). Raises if any process fails.
@@ -353,6 +363,8 @@ def launch_local(num_workers: int, command: Sequence[str],
             wenv[ENV_HISTORY_S] = str(history_s)
         if gang_poll_s is not None and task_id == 0:
             wenv[ENV_GANG_POLL_S] = str(gang_poll_s)
+        if profile_hz is not None:
+            wenv[ENV_PROFILE_HZ] = str(profile_hz)
         if ps_root is not None:
             wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
                                 num_servers, "worker", task_id))

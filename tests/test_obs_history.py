@@ -198,9 +198,12 @@ class TestTimeSeriesRing:
 
     def test_overhead_smoke_under_2pct(self, tmp_path):
         """Tier-1 gate (the ISSUE-8 acceptance number): sampling
-        enabled costs <2% of a pipeline epoch. Same interleaved
-        min-of-5 shape as the tracing overhead gate so credit drift
-        hits both sides symmetrically."""
+        enabled costs <2% of a pipeline epoch. Interleaved rounds,
+        judged on the QUIETEST adjacent (off, on) pair — climate is
+        shared inside a pair on this burstable box, where min-vs-min
+        across all rounds flaked on 2x wall swings (the PR-10
+        profiler gate's statistic, applied here for the same
+        reason)."""
         from dmlc_tpu.pipeline import Pipeline
         uri = _write_libsvm(tmp_path, rows=4000, name="overhead.libsvm")
         built = (Pipeline.from_uri(uri)
@@ -228,7 +231,9 @@ class TestTimeSeriesRing:
                 obs_ts.uninstall()
         built.close()
         assert sampled > 0  # sampling was actually on
-        assert min(on) <= min(off) * 1.02 + 0.010, (on, off)
+        grace = 0.010 / min(off)  # flat 10 ms, scaled to the wall
+        ratios = [a / b for a, b in zip(on, off)]
+        assert min(ratios) <= 1.02 + grace, (on, off, ratios)
 
 
 class TestHistogramQuantiles:

@@ -1,0 +1,787 @@
+"""Continuous sampling profiler (PR 10): merged Python+native
+flamegraphs, the /profile endpoint, and hot-frame verdict evidence.
+
+Covers: wait classification and the byte-budgeted coarsening trie
+(budget held, total sample weight conserved), collapsed/speedscope
+exports, the sampler's install/env contract, the synthetic hot-loop
+attribution gate (>=60% of the running thread's samples land on the
+known hot function), on-CPU/off-CPU separation, the <2% tier-1
+overhead gate with the sampler installed, /profile live + burst +
+404-with-hint, the obsctl profile subcommand, hot_frames evidence in
+the analyze verdict (schema 2, lint-pinned), watchdog stall reports
+and flight crash bundles attaching a forced profile (a REAL
+subprocess crash pins the bundle's profile.txt member), the native
+phase beacons (fused epoch serves a /profile with BOTH Python frames
+and native leaves; sampled parse share agrees with parse_busy_ns;
+sharded sub-parsers carry shard tags), and a REAL 2-process gang
+scraped via /profile during the run.
+"""
+
+import glob
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dmlc_tpu.obs import analyze as obs_analyze
+from dmlc_tpu.obs import flight as obs_flight
+from dmlc_tpu.obs import log as obs_log
+from dmlc_tpu.obs import profile as obs_prof
+from dmlc_tpu.obs import timeseries as obs_ts
+from dmlc_tpu.obs import trace as obs_trace
+from dmlc_tpu.obs import watchdog as obs_watchdog
+from dmlc_tpu.obs.export import (
+    collapsed_lines, speedscope_doc, write_collapsed,
+)
+from dmlc_tpu.obs.serve import StatusServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+import obsctl  # noqa: E402
+
+
+def _native_ok() -> bool:
+    from dmlc_tpu import native
+    return native.native_available()
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """No profiler, flight recorder, ring, or trace state leaks —
+    including the duty-guard's cross-instance tick-cost prior (each
+    test gets fresh-process semantics; the prior reflects whatever
+    thread population the PREVIOUS test left)."""
+    obs_prof.uninstall()
+    obs_prof._tick_cost_prior_s = 0.0
+    obs_flight.uninstall()
+    obs_ts.uninstall()
+    obs_trace.stop()
+    obs_trace.clear_fallback()
+    obs_log.reset()
+    yield
+    obs_prof.uninstall()
+    obs_flight.uninstall()
+    obs_ts.uninstall()
+    obs_trace.stop()
+    obs_trace.clear_fallback()
+    obs_log.reset()
+
+
+def _get(url: str, timeout_s: float = 15.0):
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.status, resp.read()
+
+
+def _hot_spin(seconds: float) -> int:
+    x = 0
+    deadline = time.perf_counter() + seconds
+    while time.perf_counter() < deadline:
+        for i in range(2000):
+            x += i * i
+    return x
+
+
+def _frame_weight(doc, predicate) -> int:
+    """Total self weight over frames whose name satisfies predicate."""
+    total = 0
+
+    def visit(node):
+        nonlocal total
+        if predicate(node.get("name") or ""):
+            total += int(node.get("self") or 0)
+        for c in node.get("children") or []:
+            visit(c)
+
+    for root in (doc.get("threads") or {}).values():
+        visit(root)
+    return total
+
+
+def _thread_weight(doc, label) -> int:
+    root = (doc.get("threads") or {}).get(label)
+    if root is None:
+        return 0
+    total = 0
+
+    def visit(node):
+        nonlocal total
+        total += int(node.get("self") or 0) + int(node.get("folded")
+                                                 or 0)
+        for c in node.get("children") or []:
+            visit(c)
+
+    visit(root)
+    return total
+
+
+class TestWaitClassification:
+    def test_stdlib_wait_sites(self):
+        assert obs_prof.classify_wait("threading.py", "wait")
+        assert obs_prof.classify_wait("queue.py", "get")
+        assert obs_prof.classify_wait("selectors.py", "select")
+        assert obs_prof.classify_wait("socket.py", "recv")
+
+    def test_generic_wait_names(self):
+        assert obs_prof.classify_wait("anything.py", "acquire")
+        assert obs_prof.classify_wait("worker.py", "sleep")
+
+    def test_hot_names_are_not_waits(self):
+        assert not obs_prof.classify_wait("parser.py", "tokenize")
+        assert not obs_prof.classify_wait("queue.py", "qsize")
+        assert not obs_prof.classify_wait("x.py", "get_value")
+
+
+class TestFrameTrie:
+    def test_add_and_weights(self):
+        t = obs_prof.FrameTrie()
+        t.add("main", ["a.py:f", "a.py:g"])
+        t.add("main", ["a.py:f", "a.py:g"])
+        t.add("main", ["a.py:f", "a.py:h"], wait=True)
+        doc = t.to_dict()
+        assert doc["samples"] == 3 and doc["wait_samples"] == 1
+        root = doc["threads"]["main"]
+        (f,) = root["children"]
+        assert f["name"] == "a.py:f" and f["self"] == 0
+        kids = {c["name"]: c["self"] for c in f["children"]}
+        assert kids == {"a.py:g": 2, "a.py:h": 1}
+
+    def test_coarsen_holds_budget_and_conserves_weight(self):
+        # thousands of unique cold paths against the floor budget:
+        # the trie must stay under budget by FOLDING weight upward,
+        # never by dropping samples
+        t = obs_prof.FrameTrie(budget_bytes=16 << 10)
+        n = 4000
+        for i in range(n):
+            t.add("main", [f"mod{i % 7}.py:f", f"leaf_{i}.py:g{i}"])
+        for _ in range(50):
+            t.add("main", ["mod0.py:f", "hot.py:hot"])  # the survivor
+        doc = t.to_dict()
+        assert doc["coarsenings"] > 0
+        assert doc["approx_bytes"] <= doc["budget_bytes"]
+        total = sum(w for _, w in _walk(doc))
+        assert total == doc["samples"] == n + 50
+        # the heavy path survives coarsening with its own name
+        assert _frame_weight(doc, lambda s: s == "hot.py:hot") == 50
+
+    def test_folded_weight_renders_as_coarsened_leaf(self):
+        t = obs_prof.FrameTrie(budget_bytes=16 << 10)
+        for i in range(4000):
+            t.add("main", [f"leaf_{i}.py:g{i}"])
+        lines = collapsed_lines(t.to_dict())
+        assert any(obs_prof.FOLDED_FRAME in ln for ln in lines)
+
+
+def _walk(doc):
+    from dmlc_tpu.obs.export import _walk_profile
+    return list(_walk_profile(doc))
+
+
+class TestExports:
+    def _doc(self):
+        t = obs_prof.FrameTrie()
+        t.add("main", ["a.py:f", "b.py:g"])
+        t.add("main", ["a.py:f", "b.py:g"])
+        t.add("io", ["c.py:h", "threading.py:wait",
+                     obs_prof.WAIT_FRAME], wait=True)
+        d = {"schema": obs_prof.PROFILE_SCHEMA, "hz": 10.0,
+             "duration_s": 1.0, "burst": False}
+        d.update(t.to_dict())
+        return d
+
+    def test_collapsed_lines(self):
+        lines = collapsed_lines(self._doc())
+        assert "main;a.py:f;b.py:g 2" in lines
+        assert ("io;c.py:h;threading.py:wait;"
+                f"{obs_prof.WAIT_FRAME} 1") in lines
+
+    def test_write_collapsed(self, tmp_path):
+        p = str(tmp_path / "prof.collapsed")
+        write_collapsed(self._doc(), p)
+        body = open(p).read().strip().splitlines()
+        assert body == collapsed_lines(self._doc())
+
+    def test_speedscope_golden_keys_and_weights(self):
+        ss = speedscope_doc(self._doc())
+        assert ss["$schema"] == \
+            "https://www.speedscope.app/file-format-schema.json"
+        prof = ss["profiles"][0]
+        assert prof["type"] == "sampled"
+        assert len(prof["samples"]) == len(prof["weights"])
+        assert prof["endValue"] == sum(prof["weights"]) == 3
+        names = [f["name"] for f in ss["shared"]["frames"]]
+        for s in prof["samples"]:  # every index resolves
+            for i in s:
+                assert 0 <= i < len(names)
+        assert "b.py:g" in names
+
+
+class TestStackProfiler:
+    def test_install_if_env(self, monkeypatch):
+        monkeypatch.delenv(obs_prof.ENV_PROFILE_HZ, raising=False)
+        assert obs_prof.install_if_env() is None
+        monkeypatch.setenv(obs_prof.ENV_PROFILE_HZ, "0")
+        assert obs_prof.install_if_env() is None  # 0 disables
+        monkeypatch.setenv(obs_prof.ENV_PROFILE_HZ, "97")
+        monkeypatch.setenv(obs_prof.ENV_PROFILE_BYTES, str(64 << 10))
+        prof = obs_prof.install_if_env()
+        assert prof is not None and obs_prof.active() is prof
+        assert prof.hz == 97
+        assert prof.trie.budget_bytes == 64 << 10
+        # idempotent: a second hook call returns the SAME profiler
+        assert obs_prof.install_if_env() is prof
+        obs_prof.uninstall()
+        assert obs_prof.active() is None
+        # a malformed BUDGET falls back to the default — it must not
+        # silently drop a valid rate request
+        monkeypatch.setenv(obs_prof.ENV_PROFILE_BYTES, "512k")
+        prof = obs_prof.install_if_env()
+        assert prof is not None and prof.hz == 97
+        assert prof.trie.budget_bytes == obs_prof.DEFAULT_BUDGET_BYTES
+        obs_prof.uninstall()
+
+    def test_hot_loop_attribution(self):
+        """The ISSUE acceptance: >=60% of the running thread's samples
+        land on the known hot function. Spins until the sampler has
+        collected enough of this thread — under suite load the
+        duty-cycle guard throttles the effective rate, so a fixed
+        spin time has no guaranteed sample count."""
+        prof = obs_prof.install(hz=250)
+        me = threading.current_thread().name
+        deadline = time.perf_counter() + 8.0
+        doc = prof.to_dict()
+        while time.perf_counter() < deadline:
+            _hot_spin(0.3)
+            doc = prof.to_dict()
+            if _thread_weight(doc, me) >= 20:
+                break
+        obs_prof.uninstall()
+        mine = _thread_weight(doc, me)
+        hot = _frame_weight(doc, lambda s: s.endswith(":_hot_spin"))
+        assert mine >= 10, doc["samples"]
+        assert hot >= 0.6 * mine, (hot, mine)
+
+    def test_wait_separation(self):
+        prof = obs_prof.install(hz=250)
+        ev = threading.Event()
+        t = threading.Thread(target=lambda: ev.wait(20.0),
+                             name="prof-waiter")
+        t.start()
+        deadline = time.perf_counter() + 8.0
+        doc = prof.to_dict()
+        while time.perf_counter() < deadline:
+            time.sleep(0.1)
+            doc = prof.to_dict()
+            if _thread_weight(doc, "prof-waiter") >= 3:
+                break
+        ev.set()
+        t.join()
+        obs_prof.uninstall()
+        assert doc["wait_samples"] > 0
+        # the blocked thread's samples sit under the [off-cpu] leaf
+        # (a stray bootstrap-phase sample may precede the block, so
+        # the DOMINANT share is asserted, not every line)
+        lines = [ln for ln in collapsed_lines(doc)
+                 if ln.startswith("prof-waiter;")]
+        assert lines, collapsed_lines(doc)
+        offcpu = sum(int(ln.rsplit(" ", 1)[1]) for ln in lines
+                     if obs_prof.WAIT_FRAME in ln)
+        total = _thread_weight(doc, "prof-waiter")
+        assert total > 0 and offcpu >= 0.8 * total, (offcpu, total)
+        # and the Event.wait path is named: threading.py:wait
+        assert any("threading.py:wait" in ln for ln in lines), lines
+
+    def test_sample_now_rate_limited_unless_forced(self):
+        prof = obs_prof.StackProfiler(hz=1)  # period 1 s, NOT started
+        assert prof.sample_now() is True
+        assert prof.sample_now() is False  # inside half a period
+        assert prof.sample_now(force=True) is True  # the dump bypass
+        assert prof.trie.samples >= 2
+
+    def test_burst_is_fresh_and_continuous_keeps_accumulating(self):
+        prof = obs_prof.install(hz=100)
+        _hot_spin(0.15)
+        before = prof.trie.samples
+        assert before > 0
+        # the burst runs on THIS thread and excludes itself (the
+        # /profile handler shape) — give it a workload to observe
+        spinner = threading.Thread(target=_hot_spin, args=(0.4,),
+                                   name="burst-spinner")
+        spinner.start()
+        doc = prof.burst(0.2, hz=200)
+        spinner.join()
+        assert doc["burst"] is True
+        assert doc["duration_s"] >= 0.2
+        assert doc["samples"] > 0
+        # the burst wrote a FRESH trie: the continuous one kept its
+        # pre-burst weight (and may have grown — the sampler never
+        # pauses), and the continuous dump still says burst=False
+        assert prof.trie.samples >= before
+        cont = prof.to_dict()
+        assert cont["burst"] is False
+        # the burst's own samples never land in the continuous trie:
+        # its capture thread is excluded while the burst runs, so the
+        # continuous trie carries no profile.py burst frames
+        assert _frame_weight(
+            cont, lambda s: s == "profile.py:burst") == 0
+        obs_prof.uninstall()
+
+    def test_overhead_smoke_under_2pct(self, tmp_path):
+        """Tier-1 gate (the ISSUE acceptance number): the sampler at
+        its default rate costs <2% of a pipeline epoch. Interleaved
+        min-of-5, the history/tracing gate shape, so credit drift
+        hits both sides symmetrically."""
+        from dmlc_tpu.pipeline import Pipeline
+        # epochs long enough (~0.4 s) that the flat 10 ms grace and
+        # the box's climate noise are small against the wall being
+        # compared — at 0.1 s the gate is all grace, no power
+        lines = [f"{i % 2} 1:0.5 7:1.25 9:{i}.0"
+                 for i in range(16000)]
+        uri = tmp_path / "overhead.libsvm"
+        uri.write_text("\n".join(lines) + "\n")
+        built = (Pipeline.from_uri(str(uri))
+                 .parse(format="libsvm", engine="python",
+                        chunk_size=4096)
+                 .batch(256)
+                 .build())
+
+        def epoch_wall():
+            t0 = time.perf_counter()
+            for _ in built:
+                pass
+            return time.perf_counter() - t0
+
+        epoch_wall()  # warm caches/imports outside the measurement
+        off, on = [], []
+        sampled = 0
+        # 7 rounds of adjacent (on, off) pairs, alternating which
+        # side runs first: this burstable box swings epoch walls 2x
+        # within a run (credit climate), so the gate judges the
+        # QUIETEST PAIR — climate is shared inside a pair, and a real
+        # >=2% sampler tax would show in every pair
+        for i in range(7):
+            first_on = i % 2 == 1
+            for is_on in (first_on, not first_on):
+                if is_on:
+                    prof = obs_prof.install()  # DEFAULT_HZ contract
+                    try:
+                        on.append(epoch_wall())
+                    finally:
+                        sampled += prof.trie.samples
+                        obs_prof.uninstall()
+                else:
+                    off.append(epoch_wall())
+        built.close()
+        assert sampled > 0  # sampling was actually on
+        grace = 0.010 / min(off)  # flat 10 ms, scaled to the wall
+        ratios = [a / b for a, b in zip(on, off)]
+        assert min(ratios) <= 1.02 + grace, (on, off, ratios)
+
+
+class TestProfileEndpoint:
+    def test_404_with_hint_when_uninstalled(self):
+        with StatusServer() as srv:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(srv.url("/profile"))
+            assert e.value.code == 404
+            payload = json.load(e.value)
+            assert "DMLC_TPU_PROFILE_HZ" in payload["hint"]
+
+    def test_continuous_and_burst(self):
+        prof = obs_prof.install(hz=200)
+        _hot_spin(0.2)
+        with StatusServer() as srv:
+            doc = json.loads(_get(srv.url("/profile"))[1])
+            assert doc["schema"] == obs_prof.PROFILE_SCHEMA
+            assert doc["samples"] > 0 and doc["burst"] is False
+            burst = json.loads(_get(
+                srv.url("/profile?seconds=0.2&hz=100"))[1])
+            assert burst["burst"] is True
+            assert burst["duration_s"] >= 0.2
+        assert prof is obs_prof.active()
+        obs_prof.uninstall()
+
+
+class TestObsctlProfile:
+    def test_cli_surfaces_404_payload(self, capsys):
+        """The uninstalled-server path: exit 2 and the server's
+        enable hint printed, not a bare HTTP error (the PR 8 _fetch
+        HTTPError contract)."""
+        with StatusServer() as srv:
+            rc = obsctl.main(["profile", "--port", str(srv.port)])
+        out = capsys.readouterr().out
+        assert rc == 2
+        assert "DMLC_TPU_PROFILE_HZ" in out
+
+    def test_cli_summary_and_out(self, tmp_path, capsys):
+        obs_prof.install(hz=200)
+        _hot_spin(0.25)
+        with StatusServer() as srv:
+            rc = obsctl.main(["profile", "--port", str(srv.port),
+                              "--keys", "3"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "samples" in out and "%" in out
+            dest = str(tmp_path / "p.collapsed")
+            rc = obsctl.main(["profile", "--port", str(srv.port),
+                              "--out", dest])
+            assert rc == 0 and os.path.getsize(dest) > 0
+            dest2 = str(tmp_path / "p.speedscope.json")
+            rc = obsctl.main(["profile", "--port", str(srv.port),
+                              "--out", dest2, "--format",
+                              "speedscope"])
+            assert rc == 0
+            assert "$schema" in json.load(open(dest2))
+        obs_prof.uninstall()
+
+
+def _profile_doc(threads):
+    return {"schema": obs_prof.PROFILE_SCHEMA, "hz": 100.0,
+            "duration_s": 1.0, "burst": False,
+            "samples": sum(_n(v) for v in threads.values()),
+            "wait_samples": 0, "budget_bytes": 1 << 20,
+            "approx_bytes": 1024, "coarsenings": 0, "min_fold": 2,
+            "threads": threads}
+
+
+def _n(node):
+    return (int(node.get("self") or 0) + int(node.get("folded") or 0)
+            + sum(_n(c) for c in node.get("children") or []))
+
+
+def _leaf(name, n):
+    return {"name": name, "self": n, "folded": 0, "children": []}
+
+
+def _root(label, children):
+    return {"name": label, "self": 0, "folded": 0,
+            "children": children}
+
+
+class TestVerdictHotFrames:
+    def _parse_snap(self):
+        return {"schema": 1, "epoch": 1, "wall_s": 2.0, "knobs": {},
+                "stages": [{"name": "parse", "kind": "parse",
+                            "wait_s": 1.5, "bytes": 10 ** 9}]}
+
+    def test_hot_frames_filtered_to_bound_stage(self):
+        doc = _profile_doc({"MainThread": _root("MainThread", [
+            _leaf("libsvm_parser.py:tokenize", 60),
+            _leaf("device_iter.py:xfer_drain", 40),
+        ])})
+        v = obs_analyze.attribute(self._parse_snap(), profile_doc=doc)
+        assert v["bound"] == "parse"
+        frames = [h["frame"] for h in v["hot_frames"]]
+        assert "libsvm_parser.py:tokenize" in frames
+        assert "device_iter.py:xfer_drain" not in frames
+        assert any(e.startswith("hot frames (parse)")
+                   for e in v["evidence"])
+
+    def test_native_leaves_rank_for_parse(self):
+        doc = _profile_doc({
+            "native/worker-0": _root("native/worker-0", [
+                _leaf("native:parse", 80),
+                _leaf("native:worker_wait", 20),
+            ])})
+        v = obs_analyze.attribute(self._parse_snap(), profile_doc=doc)
+        frames = [h["frame"] for h in v["hot_frames"]]
+        assert frames == ["native:parse"]  # wait leaves never rank
+
+    def test_fallback_to_overall_top_when_no_hint_matches(self):
+        doc = _profile_doc({"MainThread": _root("MainThread", [
+            _leaf("somewhere.py:unrelated", 10)])})
+        v = obs_analyze.attribute(self._parse_snap(), profile_doc=doc)
+        assert [h["frame"] for h in v["hot_frames"]] == \
+            ["somewhere.py:unrelated"]
+        # the evidence line must SAY these are overall-top frames,
+        # not claim them as the parse stage's own
+        line = next(e for e in v["evidence"]
+                    if e.startswith("hot frames"))
+        assert "overall" in line and "no sampled frame matched" in line
+
+    def test_empty_without_profiler(self):
+        assert obs_prof.active() is None
+        v = obs_analyze.attribute(self._parse_snap())
+        assert v["hot_frames"] == []
+        assert sorted(v) == sorted(obs_analyze.VERDICT_KEYS)
+        assert v["schema"] == obs_analyze.ANALYSIS_SCHEMA == 2
+
+    def test_live_profiler_feeds_verdict(self):
+        obs_prof.install(hz=250)
+        _hot_spin(0.4)
+        v = obs_analyze.attribute(self._parse_snap())
+        obs_prof.uninstall()
+        assert v["hot_frames"], "installed profiler produced no frames"
+        for h in v["hot_frames"]:
+            assert sorted(h) == ["frac", "frame", "samples"]
+
+
+class TestStallAndCrashAttachments:
+    def test_stall_report_attaches_profile(self):
+        obs_prof.install(hz=100)
+        wd = obs_watchdog.Watchdog(threshold_s=30.0)
+        report = wd._build_report([])
+        assert isinstance(report["profile"], list)
+        assert report["profile"], "forced sample left no lines"
+        obs_prof.uninstall()
+
+    def test_stall_report_without_profiler_is_none(self):
+        report = obs_watchdog.Watchdog(
+            threshold_s=30.0)._build_report([])
+        assert report["profile"] is None
+
+    def test_subprocess_crash_bundle_pins_profile_txt(self, tmp_path):
+        """A REAL worker crash under launch_local(profile_hz=...)
+        leaves a bundle whose MANIFEST pins profile.txt, holding the
+        run's collapsed stacks (env wiring included end to end)."""
+        from dmlc_tpu.parallel.launch import launch_local
+        from dmlc_tpu.utils.logging import DMLCError
+        out = str(tmp_path / "flight")
+        script = tmp_path / "crash.py"
+        script.write_text(
+            "import time\n"
+            "from dmlc_tpu.obs.profile import install_if_env\n"
+            "prof = install_if_env()\n"
+            "assert prof is not None, 'profile env missing'\n"
+            "from dmlc_tpu.obs.flight import install_if_env as fl\n"
+            "assert fl() is not None\n"
+            "deadline = time.perf_counter() + 0.4\n"
+            "x = 0\n"
+            "while time.perf_counter() < deadline:\n"
+            "    for i in range(1000):\n"
+            "        x += i\n"
+            "raise RuntimeError('deliberate profile crash')\n"
+        )
+        env = {"PYTHONPATH": os.pathsep.join(
+            [REPO] + os.environ.get("PYTHONPATH", "")
+            .split(os.pathsep))}
+        with pytest.raises(DMLCError):
+            launch_local(1, [sys.executable, str(script)], env=env,
+                         flight_dir=out, profile_hz=100, timeout=120)
+        bundles = glob.glob(os.path.join(out, "flight-*"))
+        assert len(bundles) == 1, bundles
+        manifest = json.load(open(
+            os.path.join(bundles[0], "MANIFEST.json")))
+        assert manifest["files"].get("profile.txt") == "ok"
+        body = open(os.path.join(bundles[0], "profile.txt")).read()
+        lines = [ln for ln in body.splitlines() if ln.strip()]
+        assert lines, "profile.txt is empty"
+        # collapsed-stack shape: "thread;frame;... N"
+        for ln in lines:
+            head, _, weight = ln.rpartition(" ")
+            assert head and weight.isdigit(), ln
+
+    def test_clean_exit_leaves_nothing(self, tmp_path):
+        """An uninstalled profiler + clean process: no profile.txt
+        appears anywhere (flight's clean-exit contract holds)."""
+        out = str(tmp_path / "flight")
+        fl = obs_flight.install(out_dir=out)
+        d = fl.dump("test_no_profiler")
+        assert not os.path.exists(os.path.join(d, "profile.txt"))
+        manifest = json.load(open(os.path.join(d, "MANIFEST.json")))
+        assert "profile.txt" not in manifest["files"]
+        obs_flight.uninstall()
+
+
+@pytest.mark.skipif(not _native_ok(), reason="native engine not built")
+class TestNativeBeacons:
+    def _corpus(self, tmp_path, rows=120000):
+        p = tmp_path / "beacon.libsvm"
+        with open(p, "w") as f:
+            for i in range(rows):
+                f.write(f"{i % 2} {i % 97}:1.5 {(i * 7) % 89}:2.25 "
+                        f"{(i * 3) % 53}:0.5\n")
+        return str(p)
+
+    def test_profile_serves_merged_python_and_native(self, tmp_path):
+        """THE acceptance: a live run serves /profile with a merged
+        flamegraph holding BOTH Python frames and native phase
+        leaves."""
+        from dmlc_tpu.native import bindings
+        path = self._corpus(tmp_path)
+        obs_prof.install(hz=250)
+        par = bindings.NativeLibSVMParser(path, nthreads=2,
+                                          chunk_size=16384)
+        par.set_test_touch_rounds(60)  # real byte-touching work: the
+        # epoch spans many sampler ticks without sleeping
+        done = threading.Event()
+
+        def consume():
+            while par.next():
+                pass
+            done.set()
+
+        def merged(d):
+            labels = set(d.get("threads") or {})
+            return (_frame_weight(
+                d, lambda s: s == "native:parse") > 0
+                and any(not lb.startswith("native/")
+                        for lb in labels))
+
+        t = threading.Thread(target=consume, name="beacon-consumer")
+        doc = None
+        with StatusServer() as srv:
+            t.start()
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                doc = json.loads(_get(srv.url("/profile"))[1])
+                # the trie is cumulative: a post-epoch fetch still
+                # carries everything sampled during the run
+                if merged(doc) or done.is_set():
+                    break
+                time.sleep(0.02)
+            if doc is not None and not merged(doc):
+                doc = json.loads(_get(srv.url("/profile"))[1])
+            t.join(timeout=60)
+        par.destroy()
+        obs_prof.uninstall()
+        assert doc is not None
+        labels = set(doc["threads"])
+        assert any(lb.startswith("native/worker") for lb in labels), \
+            labels
+        assert any(not lb.startswith("native/") for lb in labels), \
+            labels
+        assert _frame_weight(doc, lambda s: s == "native:parse") > 0
+
+    def test_beacon_parity_with_busy_counters(self, tmp_path):
+        """The sampled native:parse share of worker samples agrees
+        with the engine's own parse_busy_ns busy share — the beacons
+        attribute the same time the counters measure."""
+        from dmlc_tpu.native import bindings
+        path = self._corpus(tmp_path)
+        nthreads = 2
+        par = bindings.NativeLibSVMParser(path, nthreads=nthreads,
+                                          chunk_size=16384)
+        # heavy per-chunk byte-touching: the epoch spans enough
+        # sampler ticks for the share comparison to have power even
+        # under the duty-cycle guard's throttled effective rate —
+        # and IDENTICAL epochs repeat until the floor is met (the
+        # guard makes per-epoch sample counts load-dependent; the
+        # busy SHARE is stationary across replays)
+        par.set_test_touch_rounds(160)
+        prof = obs_prof.install(hz=250)
+        parse = wait = 0
+        for _ in range(6):
+            while par.next():
+                pass
+            doc = prof.to_dict()
+            stats = par.stats()
+            parse = _frame_weight(doc, lambda s: s == "native:parse")
+            wait = _frame_weight(doc,
+                                 lambda s: s == "native:worker_wait")
+            if parse + wait >= 20:
+                break
+            par.before_first()
+        par.destroy()
+        obs_prof.uninstall()
+        assert parse + wait >= 20, (parse, wait, doc["samples"])
+        sampled_share = parse / (parse + wait)
+        busy_share = stats["parse_busy_ns"] / (
+            nthreads * max(1, stats["wall_ns"]))
+        assert abs(sampled_share - busy_share) <= 0.35, \
+            (sampled_share, busy_share, parse, wait, stats)
+        # the busy side must dominate under touch-round load: the
+        # beacons would fail this if parse/wait were swapped
+        assert sampled_share > 0.5, (sampled_share, busy_share)
+
+    def test_sharded_subs_carry_shard_tags(self, tmp_path):
+        from dmlc_tpu.native import bindings
+        path = self._corpus(tmp_path, rows=60000)
+        sp = bindings.NativeShardedTextParser(
+            path, shards=2, format="libsvm", nthreads=2,
+            chunk_size=16384)
+        shards = set()
+        stop = threading.Event()
+
+        def poll():
+            while not stop.is_set():
+                for kind, _idx, _phase, shard in bindings.prof_read():
+                    if kind in (1, 2):  # reader/worker slots
+                        shards.add(shard)
+                time.sleep(0.002)
+
+        t = threading.Thread(target=poll)
+        t.start()
+        while sp.next_padded(4096, row_bucket=4096,
+                             nnz_bucket=4096 * 3) is not None:
+            pass
+        stop.set()
+        t.join()
+        sp.destroy()
+        assert {0, 1} <= shards, shards
+        # slots release with the pipelines: nothing leaks after destroy
+        assert bindings.prof_read() == []
+
+
+class TestGangProfileLive:
+    def test_two_process_gang_serves_profile(self, tmp_path):
+        """Extends the PR 4/8 scrape-under-load pattern: a REAL
+        2-process launch_local gang under profile_hz serves /profile
+        on every rank DURING the run, samples rising."""
+        from dmlc_tpu.parallel.launch import (
+            find_free_ports, launch_local,
+        )
+        script = tmp_path / "gang_worker.py"
+        stop_file = tmp_path / "stop"
+        script.write_text(
+            "import os, sys, time\n"
+            "from dmlc_tpu.obs.serve import serve_if_env\n"
+            "from dmlc_tpu.obs.profile import install_if_env\n"
+            "assert serve_if_env() is not None\n"
+            "assert install_if_env() is not None\n"
+            "deadline = time.time() + 60\n"
+            "x = 0\n"
+            "while time.time() < deadline:\n"
+            "    for i in range(20000):\n"
+            "        x += i * i\n"
+            "    if os.path.exists(sys.argv[1]):\n"
+            "        break\n"
+        )
+        ports = find_free_ports(2)
+        env = {"PYTHONPATH": os.pathsep.join(
+            [REPO] + os.environ.get("PYTHONPATH", "")
+            .split(os.pathsep))}
+        result = {}
+
+        def gang():
+            try:
+                result["codes"] = launch_local(
+                    2, [sys.executable, str(script), str(stop_file)],
+                    env=env, serve_ports=ports, profile_hz=97,
+                    timeout=90)
+            except Exception as e:  # noqa: BLE001
+                result["error"] = e
+
+        t = threading.Thread(target=gang, daemon=True)
+        t.start()
+        try:
+            deadline = time.time() + 45.0
+            docs = {}
+            while time.time() < deadline and len(docs) < 2:
+                for port in ports:
+                    if port in docs:
+                        continue
+                    try:
+                        doc = json.loads(_get(
+                            f"http://127.0.0.1:{port}/profile",
+                            timeout_s=2.0)[1])
+                    except (OSError, urllib.error.URLError,
+                            ValueError):
+                        continue
+                    if doc.get("samples"):
+                        docs[port] = doc
+                time.sleep(0.05)
+            assert len(docs) == 2, \
+                f"gang never served /profile: {result}"
+            for doc in docs.values():
+                assert doc["schema"] == obs_prof.PROFILE_SCHEMA
+                assert doc["hz"] == 97
+                assert doc["threads"], doc
+        finally:
+            stop_file.write_text("stop")
+            t.join(timeout=60.0)
+        assert result.get("codes") == [0, 0], result
