@@ -1253,6 +1253,100 @@ def bench_recio_native(mb: int, gauge_fn=None) -> Dict:
     return out
 
 
+def bench_peer_hydrate(mb: int) -> Dict:
+    """Config 15 (ROADMAP item 5): a REAL 2-process gang over one
+    ``obj://`` object, each rank with its OWN page store, peer-serving
+    hydrated blocks through the ``/pages`` data plane. Asserts the
+    tentpole's acceptance — each rank's cold wire bytes ≈ corpus/N
+    (within PEER_SLACK: peer-retry exhaustion double-fetches a block
+    occasionally, it must stay rare), the gang total ≈ 1× the corpus
+    (vs N× without the tier), a wire-free warm epoch on EVERY rank,
+    and every rank's stream sha256-identical to the local bytes."""
+    import hashlib
+    import sys
+    import tempfile
+
+    import dmlc_tpu.io.objstore as objstore
+    from dmlc_tpu.parallel.launch import launch_local
+
+    # ideal per-rank share is 1/N; the slack covers peer-ladder
+    # exhaustion double-fetches (the acceptance bound: <= ~60% of the
+    # single-rank wire bytes per rank for N=2)
+    PEER_SLACK = 0.60
+
+    path = f"{_TMP}.peer.libsvm"
+    size = make_libsvm(path, mb, seed=7, nnz_range=(25, 45),
+                       index_space=10 ** 6, real_values=True)
+    with open(path, "rb") as f:
+        local_hash = hashlib.sha256(f.read()).hexdigest()
+    em = objstore.configure(root=f"{_TMP}.peer.objroot")
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_peer_worker.py")
+    out_dir = tempfile.mkdtemp(prefix="dmlc_bench_peer_")
+    block_bytes, coalesce = 1 << 20, 4
+    env = {
+        objstore.ENV_ROOT: f"{_TMP}.peer.objroot",
+        objstore.ENV_LATENCY: "0.002",  # a modeled wire: GETs cost
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + [p for p in os.environ.get("PYTHONPATH",
+                                         "").split(os.pathsep) if p]),
+    }
+    try:
+        em.put_file("bench", "peer/train.libsvm", path)
+        launch_local(2, [sys.executable, worker,
+                         "obj://bench/peer/train.libsvm", out_dir,
+                         str(block_bytes), str(coalesce)],
+                     env=env, serve_ports=True, timeout=600)
+        results = []
+        for rank in range(2):
+            with open(os.path.join(out_dir,
+                                   f"peer-{rank}.json")) as f:
+                results.append(json.load(f))
+    finally:
+        import shutil
+        shutil.rmtree(out_dir, ignore_errors=True)
+        objstore.configure(None)
+
+    per_rank_wire = [r["cold"]["counters"]["objstore.bytes"]
+                     for r in results]
+    per_rank_peer = [r["cold"]["counters"]["objstore.peer.bytes"]
+                     for r in results]
+    for r in results:
+        assert r["cold"]["sha256"] == local_hash, \
+            f"rank {r['rank']} cold stream diverged from local bytes"
+        assert r["warm"]["sha256"] == local_hash
+        assert r["warm"]["counters"]["objstore.get"] == 0, \
+            (f"rank {r['rank']} warm epoch hit the wire: "
+             f"{r['warm']['counters']['objstore.get']} GETs")
+        assert r["cold"]["counters"]["objstore.peer.bytes"] > 0, \
+            f"rank {r['rank']} peer-served nothing (tier inert?)"
+    for rank, wired in enumerate(per_rank_wire):
+        assert wired <= PEER_SLACK * size, \
+            (f"rank {rank} moved {wired} wire bytes > "
+             f"{PEER_SLACK:.0%} of the {size}-byte corpus — the peer "
+             "tier did not carry its half")
+    total_wire = sum(per_rank_wire)
+    assert total_wire >= 0.9 * size, \
+        "gang total wire bytes below the corpus (counter bug?)"
+    cold_wall = max(r["cold"]["wall_s"] for r in results)
+    warm_wall = max(r["warm"]["wall_s"] for r in results)
+    return {"config": "peer_hydrate", "procs": 2, "bytes": size,
+            "gbps": size / warm_wall / 1e9,  # steady gang cadence
+            "hydrate_gbps": round(size / cold_wall / 1e9, 4),
+            "wire_bytes_per_rank": per_rank_wire,
+            "peer_bytes_per_rank": per_rank_peer,
+            "gang_wire_frac": round(total_wire / (2 * size), 4),
+            "single_rank_wire_frac": [round(w / size, 4)
+                                      for w in per_rank_wire],
+            "peer_miss_per_rank": [
+                r["cold"]["counters"]["objstore.peer.miss"]
+                for r in results],
+            "warm_gets": [r["warm"]["counters"]["objstore.get"]
+                          for r in results],
+            "hash": local_hash}
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -1268,13 +1362,14 @@ CONFIGS = {
     12: ("native_assembly", lambda mb, dev: bench_native_assembly(mb)),
     13: ("analyze", lambda mb, dev: bench_analyze(mb)),
     14: ("recio_native", lambda mb, dev: bench_recio_native(mb)),
+    15: ("peer_hydrate", lambda mb, dev: bench_peer_hydrate(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-14 (0 = all)")
+                    help="1-15 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -1334,7 +1429,8 @@ def main(argv: Optional[List[str]] = None) -> None:
             # interleaves 3 native epochs per contender (self-warming —
             # and its python-golden leg is ~100x the native one, so a
             # warm pass would double the slowest part of the suite)
-            if not args.cold and n not in (7, 8, 9, 10, 11, 13, 14):
+            # ... and config 15's gang manages its own cold/warm split
+            if not args.cold and n not in (7, 8, 9, 10, 11, 13, 14, 15):
                 fn(args.mb, args.device)  # warm imports + page cache
             trace_path = None
             if args.trace:

@@ -23,16 +23,21 @@ harness. See docs/remote_io.md.
 """
 
 from dmlc_tpu.io.filesys import FileSystem
+from dmlc_tpu.io.objstore import peer
 from dmlc_tpu.io.objstore.emulator import EmulatedObjectStore, ObjectInfo
 from dmlc_tpu.io.objstore.fs import (
-    ENV_GBPS, ENV_LATENCY, ENV_ROOT, ObjectSeekStream,
-    ObjectStoreFileSystem, client, configure, options,
+    ENV_AUTH, ENV_ENDPOINT, ENV_GBPS, ENV_LATENCY, ENV_ROOT,
+    ObjectSeekStream, ObjectStoreFileSystem, client, configure, options,
 )
+
+# NOTE: http_client (the real networked ranged-GET client) is
+# import-optional by design — configure(endpoint=...) loads it lazily;
+# importing this package must not pull the wire stack in.
 
 __all__ = [
     "ObjectStoreFileSystem", "ObjectSeekStream", "EmulatedObjectStore",
-    "ObjectInfo", "configure", "client", "options",
-    "ENV_ROOT", "ENV_LATENCY", "ENV_GBPS",
+    "ObjectInfo", "configure", "client", "options", "peer",
+    "ENV_ROOT", "ENV_LATENCY", "ENV_GBPS", "ENV_ENDPOINT", "ENV_AUTH",
 ]
 
 # register the schemes: obj:// is the canonical name, s3:// aliases to

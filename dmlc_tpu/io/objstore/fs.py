@@ -38,10 +38,35 @@ Read path (:class:`ObjectSeekStream`):
   bytes, ``objstore.bytes_served`` the decompressed payload — see
   docs/remote_io.md "Page compression" for when the trade pays.
 
+Two tiers sit AHEAD of the wire (ROADMAP item 5, the gang-scale data
+plane):
+
+- **gang peers** (:mod:`dmlc_tpu.io.objstore.peer`): in a gang whose
+  ranks run the StatusServer (``launch_local(serve_ports=...)``),
+  hydration groups — contiguous runs of ``coalesce`` blocks — are
+  OWNED round-robin by rank, the owner fetches its groups from the
+  wire, and every other rank asks the owner's ``/pages/<entry>``
+  endpoint first (fingerprint- and length-validated, decoded, under
+  the ``io.objstore.peer`` resilience seam). A cold N-rank epoch moves
+  ~1/N of the single-rank wire bytes; any peer trouble degrades to
+  the wire, never to corruption or a hang;
+- **singleflight** (process-local): concurrent misses of the same
+  hydration group dedup onto ONE fetch — the leader fills the store,
+  waiters read the committed page (``pagestore.singleflight.lead`` /
+  ``pagestore.singleflight.dedup`` counters make the dedup
+  auditable). A waiter whose block the leader's span did not cover
+  simply fetches it itself.
+
 Hydrated entries are stamped with the object's ``[uri, size, mtime]``
 fingerprint AND keyed by its etag: a changed object changes the key
 (stale blocks are never served) and the stale sweep reclaims the old
 generation's pages.
+
+The wire client is pluggable: the on-disk emulator (tests/bench), or
+the REAL networked HTTP ranged-GET client
+(:mod:`dmlc_tpu.io.objstore.http_client`, import-optional — built only
+when ``configure(endpoint=...)`` / ``DMLC_TPU_OBJSTORE_ENDPOINT``
+names one).
 """
 
 from __future__ import annotations
@@ -60,20 +85,27 @@ from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = [
     "ObjectStoreFileSystem", "ObjectSeekStream", "configure", "client",
-    "options", "ENV_ROOT", "ENV_LATENCY", "ENV_GBPS",
+    "options", "ENV_ROOT", "ENV_LATENCY", "ENV_GBPS", "ENV_ENDPOINT",
+    "ENV_AUTH",
 ]
 
 ENV_ROOT = "DMLC_TPU_OBJSTORE_ROOT"
 ENV_LATENCY = "DMLC_TPU_OBJSTORE_LATENCY_S"
 ENV_GBPS = "DMLC_TPU_OBJSTORE_GBPS"
+ENV_ENDPOINT = "DMLC_TPU_OBJSTORE_ENDPOINT"
+ENV_AUTH = "DMLC_TPU_OBJSTORE_AUTH"  # "Header-Name: value" static auth
 
 _lock = threading.Lock()
 _client = None
 _options = {
     "block_bytes": 4 << 20,   # hydration/GET granularity
     "coalesce": 4,            # max adjacent missing blocks per span
+                              # (ALSO the peer tier's ownership-group
+                              # size, so owned wire fetches coalesce)
     "parallel": 4,            # concurrent ranged GETs per span
     "hydrate": True,          # write fetched blocks into the PageStore
+    "peer": True,             # consult gang peers (when a tier exists)
+                              # before the wire
     "codec_level": None,      # io.codec level for the wire + hydrated
                               # pages; None = the process default
                               # (DMLC_TPU_PAGE_CODEC_LEVEL), 0 = raw
@@ -84,22 +116,30 @@ _KEEP = object()  # configure() default: tune options, keep the client
 
 
 def configure(client_obj=_KEEP, *, root: Optional[str] = None,
+              endpoint: Optional[str] = None,
+              auth=None,
               latency_s: float = 0.0,
               bandwidth_gbps: Optional[float] = None,
               block_bytes: Optional[int] = None,
               coalesce: Optional[int] = None,
               parallel: Optional[int] = None,
               hydrate: Optional[bool] = None,
+              peer: Optional[bool] = None,
               codec_level: Optional[int] = None):
-    """Install the process's object-store client (or build an
+    """Install the process's object-store client and tune the read
+    path. Returns the installed client. The client is, in order:
+    ``client_obj`` verbatim; an
     :class:`~dmlc_tpu.io.objstore.emulator.EmulatedObjectStore` over
-    ``root``) and tune the read path. Returns the installed client.
-    An explicit ``configure(None)`` with no root uninstalls; calling
-    with only option kwargs (e.g. ``configure(hydrate=False)``) tunes
-    the read path without touching the installed client."""
+    ``root``; the real networked
+    :class:`~dmlc_tpu.io.objstore.http_client.HttpObjectStoreClient`
+    over ``endpoint`` (``auth`` = static header dict or a callable
+    returning one, the auth-header hook). An explicit
+    ``configure(None)`` with neither uninstalls; calling with only
+    option kwargs (e.g. ``configure(hydrate=False)``) tunes the read
+    path without touching the installed client."""
     global _client
     with _lock:
-        if client_obj is _KEEP and root is None:
+        if client_obj is _KEEP and root is None and endpoint is None:
             client_obj = _client
         elif client_obj is None or client_obj is _KEEP:
             if root is not None:
@@ -109,6 +149,14 @@ def configure(client_obj=_KEEP, *, root: Optional[str] = None,
                 client_obj = EmulatedObjectStore(
                     root, latency_s=latency_s,
                     bandwidth_gbps=bandwidth_gbps)
+            elif endpoint is not None:
+                # import-optional: the real wire client loads only
+                # when an endpoint names one (the emulator stays the
+                # test backend)
+                from dmlc_tpu.io.objstore.http_client import (
+                    HttpObjectStoreClient,
+                )
+                client_obj = HttpObjectStoreClient(endpoint, auth=auth)
             else:
                 client_obj = None  # explicit uninstall
         _client = client_obj
@@ -116,6 +164,7 @@ def configure(client_obj=_KEEP, *, root: Optional[str] = None,
                          ("coalesce", coalesce),
                          ("parallel", parallel),
                          ("hydrate", hydrate),
+                         ("peer", peer),
                          ("codec_level", codec_level)):
             if val is not None:
                 _options[key] = val
@@ -127,9 +176,11 @@ def configure(client_obj=_KEEP, *, root: Optional[str] = None,
 
 def client():
     """The configured client; falls back to the ``DMLC_TPU_OBJSTORE_*``
-    env contract (an emulator over ``DMLC_TPU_OBJSTORE_ROOT``), so gang
-    workers inherit the launcher's store with zero code. None when
-    nothing is configured."""
+    env contract — an emulator over ``DMLC_TPU_OBJSTORE_ROOT``, else
+    the real HTTP client over ``DMLC_TPU_OBJSTORE_ENDPOINT`` (with an
+    optional ``DMLC_TPU_OBJSTORE_AUTH="Header: value"`` static auth
+    header) — so gang workers inherit the launcher's store with zero
+    code. None when nothing is configured."""
     global _client
     with _lock:
         if _client is not None:
@@ -141,6 +192,20 @@ def client():
             latency_s=float(os.environ.get(ENV_LATENCY, "0") or "0"),
             bandwidth_gbps=(float(os.environ[ENV_GBPS])
                             if os.environ.get(ENV_GBPS) else None))
+    endpoint = os.environ.get(ENV_ENDPOINT)
+    if endpoint:
+        auth = None
+        raw = os.environ.get(ENV_AUTH)
+        if raw:
+            # fail FAST on a malformed value: silently dropping it
+            # would send unauthenticated requests and surface only as
+            # baffling 403s from the endpoint
+            check(":" in raw,
+                  f"{ENV_AUTH} must be 'Header-Name: value', got "
+                  f"{raw!r}")
+            name, _, value = raw.partition(":")
+            auth = {name.strip(): value.strip()}
+        return configure(endpoint=endpoint, auth=auth)
     return None
 
 
@@ -157,8 +222,51 @@ def _count(which: str, n: int = 1) -> None:
         pass
 
 
+def _count_sf(which: str) -> None:
+    try:
+        from dmlc_tpu.obs.metrics import REGISTRY
+        REGISTRY.counter(f"pagestore.singleflight.{which}").inc()
+    except Exception:  # noqa: BLE001 — telemetry must not break I/O
+        pass
+
+
 def _bucket_key(uri: URI) -> Tuple[str, str]:
     return uri.host, uri.name.lstrip("/")
+
+
+class _Singleflight:
+    """Process-local hydration dedup: concurrent misses of one
+    hydration group elect ONE leader whose fetch fills the page store;
+    the waiters then read the committed page instead of issuing their
+    own GETs. Bounded wait (a crashed leader's followers proceed on
+    their own after ``wait_s``) — dedup is an optimization, never a
+    correctness dependency."""
+
+    def __init__(self, wait_s: float = 120.0):
+        self.wait_s = wait_s
+        self._lock = threading.Lock()
+        self._inflight: dict = {}
+
+    def lead(self, key) -> bool:
+        """True: caller is the leader (MUST call :meth:`done`).
+        False: another thread led; its fetch has completed (or the
+        bounded wait expired) by the time this returns."""
+        with self._lock:
+            ev = self._inflight.get(key)
+            if ev is None:
+                self._inflight[key] = threading.Event()
+                return True
+        ev.wait(self.wait_s)
+        return False
+
+    def done(self, key) -> None:
+        with self._lock:
+            ev = self._inflight.pop(key, None)
+        if ev is not None:
+            ev.set()
+
+
+_SINGLEFLIGHT = _Singleflight()
 
 
 class ObjectSeekStream(SeekStream):
@@ -188,6 +296,16 @@ class ObjectSeekStream(SeekStream):
         self._store = (store if store is not None
                        else (PageStore.default() if opts["hydrate"]
                              else None))
+        # the gang peer tier (None outside a gang or when peer=False):
+        # hydration groups of `coalesce` blocks are owned round-robin
+        # by rank; non-owners ask the owner's /pages endpoint first
+        self._peer = None
+        if opts.get("peer", True):
+            from dmlc_tpu.io.objstore import peer as _peer_mod
+            t = _peer_mod.tier()
+            if t is not None and t.remote_count > 0:
+                self._peer = t
+        self._group = max(1, self._coalesce)
         # entry names carry the object identity AND its etag: a changed
         # object hydrates a fresh generation, never mixes with the old
         oh = hashlib.sha256(self.path.encode()).hexdigest()[:16]
@@ -240,35 +358,99 @@ class ObjectSeekStream(SeekStream):
         return min(self.size, (ix + 1) * self._bb) - ix * self._bb
 
     def _block(self, ix: int) -> bytes:
-        from dmlc_tpu.io.codec import decode_page
         if ix == self._cur_ix:
             return self._cur
-        data = None
-        if self._store is not None:
-            s = self._store.open_read(self._entry(ix))
-            if s is not None:
-                with s:
-                    data = s.read_all()
-                try:
-                    # hydrated entries may be codec-framed (the sidecar
-                    # stamps which); raw legacy pages pass through
-                    data = decode_page(data)
-                except DMLCError:
-                    data = b""  # corrupt frame: treat as torn below
-                if len(data) != self._expected(ix):
-                    # torn/foreign page: refetch rather than serve it
-                    self._store.delete(self._entry(ix))
-                    data = None
+        data = self._store_block(ix)
         if data is None:
-            data = self._fetch_span(ix)
+            data = self._fetch_missing(ix)
         self._cur_ix, self._cur = ix, data
         return data
 
-    def _fetch_span(self, ix: int) -> bytes:
+    def _store_block(self, ix: int) -> Optional[bytes]:
+        """Block ``ix`` from the page store, decoded and
+        length-validated; None on a miss (a torn/foreign page is
+        deleted and reported as a miss — refetch, never serve it)."""
+        from dmlc_tpu.io.codec import decode_page
+        if self._store is None:
+            return None
+        s = self._store.open_read(self._entry(ix))
+        if s is None:
+            return None
+        with s:
+            data = s.read_all()
+        try:
+            # hydrated entries may be codec-framed (the sidecar
+            # stamps which); raw legacy pages pass through
+            data = decode_page(data)
+        except DMLCError:
+            data = b""  # corrupt frame: treat as torn below
+        if len(data) != self._expected(ix):
+            self._store.delete(self._entry(ix))
+            return None
+        return data
+
+    def _fetch_missing(self, ix: int) -> bytes:
+        """A store miss: singleflight the hydration group, then
+        peer-or-wire. The leader's fetch commits the span; followers
+        read the committed pages (one GET fills the gang member's
+        store for every concurrent reader)."""
+        if self._store is None:
+            # nothing to dedup INTO — every reader fetches its own
+            return self._peer_or_wire(ix)
+        key = (self._entry_prefix, self._bb, ix // self._group)
+        if _SINGLEFLIGHT.lead(key):
+            _count_sf("lead")
+            try:
+                return self._peer_or_wire(ix)
+            finally:
+                _SINGLEFLIGHT.done(key)
+        _count_sf("dedup")
+        data = self._store_block(ix)
+        if data is not None:
+            return data
+        # the leader's span stopped short of our block (or its commit
+        # failed): fetch it ourselves
+        return self._peer_or_wire(ix)
+
+    def _peer_or_wire(self, ix: int) -> bytes:
+        """The tiered fetch for one missing block: gang peer (when the
+        group is owned by another rank) ahead of the object store."""
+        tier = self._peer
+        if tier is None:
+            return self._fetch_span(ix)
+        group_ix = ix // self._group
+        owner = tier.owner_index(group_ix)
+        if owner is None:
+            # self-owned: fetch from the wire, clamped to OUR group so
+            # the coalesced span never pre-fetches a peer-owned block
+            end_of_group = (group_ix + 1) * self._group
+            return self._fetch_span(ix, limit_blocks=end_of_group - ix)
+        data = tier.fetch_entry(owner, self._entry(ix),
+                                self._fingerprint, self._expected(ix))
+        if data is not None:
+            if self._store is not None:
+                self._hydrate(ix, data)
+            return data
+        if tier.available(owner):
+            # the owner is alive but behind (or served a bad page):
+            # take just this block from the wire — the owner will
+            # still serve the rest of its group
+            return self._fetch_span(ix, limit_blocks=1)
+        # breaker open (dead peer): its groups are ours now, full
+        # coalesced spans and all
+        return self._fetch_span(ix)
+
+    def _fetch_span(self, ix: int,
+                    limit_blocks: Optional[int] = None) -> bytes:
         """Fetch the run of store-missing blocks starting at ``ix``
-        (request coalescing), as up to ``parallel`` concurrent ranged
-        GETs; hydrate every fetched block. Returns block ``ix``."""
-        last = min(ix + self._coalesce, self._nblocks())
+        (request coalescing, capped at ``limit_blocks`` when the peer
+        tier bounds the span to an ownership group), as up to
+        ``parallel`` concurrent ranged GETs; hydrate every fetched
+        block. Returns block ``ix``."""
+        span_blocks = self._coalesce
+        if limit_blocks is not None:
+            span_blocks = max(1, min(span_blocks, limit_blocks))
+        last = min(ix + span_blocks, self._nblocks())
         j = ix + 1
         while j < last and not (self._store is not None
                                 and self._store.exists(self._entry(j))):

@@ -48,10 +48,11 @@ from dmlc_tpu.utils.logging import DMLCError, check
 __all__ = [
     "PageStore", "PageWriter", "default_store_dir",
     "stat_uri", "stat_fingerprint", "fingerprint_fresh",
-    "ENV_BUDGET", "META_SUFFIX",
+    "ENV_BUDGET", "ENV_STORE_DIR", "META_SUFFIX",
 ]
 
 ENV_BUDGET = "DMLC_TPU_PAGESTORE_BUDGET"
+ENV_STORE_DIR = "DMLC_TPU_PAGESTORE_DIR"
 META_SUFFIX = ".meta.json"
 
 _TMP_RE = re.compile(r"\.tmp(?:\.(\d+))?$")
@@ -64,7 +65,14 @@ _NAME_PID_RE = re.compile(r"-p(\d+)-\d+\.pages(\.tmp)?$")
 def default_store_dir() -> str:
     """The shared default root: spill pages, derived caches, and
     hydrated remote blocks all land here unless a caller names a
-    directory — one dir, one sweep, one budget."""
+    directory — one dir, one sweep, one budget.
+    ``DMLC_TPU_PAGESTORE_DIR`` overrides it (read per call, so a gang
+    worker sharing a host with its peers can give each rank its OWN
+    store — what the objstore peer tier's ``/pages`` endpoint and the
+    config-15 gang bench rely on)."""
+    env = os.environ.get(ENV_STORE_DIR)
+    if env:
+        return env
     return os.path.join(tempfile.gettempdir(), "dmlc_tpu_spill")
 
 

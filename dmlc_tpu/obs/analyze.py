@@ -222,6 +222,9 @@ def attribute(pipeline_snap: Dict[str, Any],
     obj_bytes = _counter(metrics, "objstore.bytes")
     obj_served = _counter(metrics, "objstore.bytes_served")
     obj_payload = obj_served or obj_bytes
+    peer_gets = _counter(metrics, "objstore.peer.get")
+    peer_bytes = _counter(metrics, "objstore.peer.bytes")
+    peer_miss = _counter(metrics, "objstore.peer.miss")
     hit_rate = (ps_hit / (ps_hit + ps_miss)
                 if (ps_hit + ps_miss) else None)
     pipeline_bytes = max((int(st.get("bytes") or 0) for st in stages),
@@ -264,6 +267,19 @@ def attribute(pipeline_snap: Dict[str, Any],
                          "compressed wire -> "
                          f"{obj_served / wall / 1e9:.3f} GB/s served")
             line += ")"
+        evidence.append(line)
+    if peer_gets or peer_bytes or peer_miss:
+        # the gang peer tier split: bytes that arrived from peers'
+        # /pages endpoints never touched the wire — the 1/N claim,
+        # named as rates so a wire verdict says which tier carried it
+        line = (f"peer tier: {int(peer_gets)} peer GETs, "
+                f"{int(peer_bytes)} peer-served bytes, "
+                f"{int(peer_miss)} degraded to the wire")
+        if wall > 0 and (peer_bytes or obj_payload):
+            line += (f" ({peer_bytes / wall / 1e9:.3f} GB/s "
+                     "peer-served vs "
+                     f"{obj_payload / wall / 1e9:.3f} GB/s "
+                     "wire-served)")
         evidence.append(line)
     for name, occ in occupancies:
         if occ >= 0.8:

@@ -36,7 +36,18 @@ rank serves:
   Python+native flamegraph (:mod:`dmlc_tpu.obs.profile`): the
   continuous trie, or an on-demand burst capture of the next N
   seconds at M Hz (404 with an enable hint when no profiler is
-  installed, like ``/history``).
+  installed, like ``/history``);
+- ``GET /pages/<entry>`` — the gang peer DATA plane (ROADMAP item 5):
+  serves one committed, fingerprint-fresh page-store entry's bytes
+  (``Range: bytes=a-b`` honored with a 206) under a refcounted pin,
+  stamping the entry's fingerprint and codec tag as response headers
+  so the peer client (:mod:`dmlc_tpu.io.objstore.peer`) can validate
+  before trusting a byte. Stale-stamped, uncommitted, or
+  unsafely-named entries answer 404 — a peer can degrade to the wire,
+  it must never be fed a wrong page. This endpoint is why
+  ``ThreadingHTTPServer`` matters: a slow ``/pages`` body transfer
+  runs on its own handler thread and cannot starve ``/healthz`` or
+  ``/metrics`` scrapes.
 
 ``launch_local(serve_ports=[...])`` hands every worker a port via
 ``DMLC_TPU_SERVE_PORT`` (workers opt in with one :func:`serve_if_env`
@@ -71,6 +82,17 @@ ENV_SERVE_PORTS = "DMLC_TPU_SERVE_PORTS"  # comma-joined gang ports
 # /trace?seconds=N is clamped here: the handler thread sleeps for the
 # capture window and an unbounded N would pin it (and the client)
 MAX_TRACE_CAPTURE_S = 60.0
+
+# /pages freshness verdicts are cached briefly: re-statting the origin
+# per served block (a HEAD for obj:// sources) would erode the 1/N
+# wire saving the peer tier delivers. A stale page can thus be served
+# for up to the TTL — bounded and safe: entry names are etag-keyed (a
+# changed object changes the requested name) and the peer CLIENT
+# independently validates the stamped fingerprint before trusting a
+# byte. Keyed by (root, name, stamp), so a re-stamped entry is
+# re-judged immediately.
+PAGE_FRESH_TTL_S = 2.0
+_page_fresh_cache: Dict[tuple, tuple] = {}
 
 _name_ok = re.compile(r"[^a-z0-9_]")
 
@@ -273,6 +295,90 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(payload).encode(),
                    "application/json")
 
+    def _serve_page(self, owner: "StatusServer", name: str) -> None:
+        """The /pages/<entry> peer data plane: one committed,
+        fingerprint-fresh page-store entry's stored bytes, under a
+        refcounted pin so eviction cannot pull the page mid-transfer.
+        The stored bytes may be a codec frame — the client decodes;
+        headers carry the stamped fingerprint + codec tag for the
+        client's own validation. Ranges (``Range: bytes=a-b``) apply
+        to the STORED entry bytes and answer 206."""
+        from urllib.parse import unquote
+
+        from dmlc_tpu.io.pagestore import fingerprint_fresh
+        name = unquote(name)
+        # entry names are flat files in the store root: anything
+        # path-shaped is rejected before it touches the filesystem
+        if (not name or "/" in name or "\\" in name or ".." in name
+                or name.startswith(".")):
+            self._send_json({"error": "invalid page name"}, code=404)
+            return
+        store = owner.pages_store()
+        meta = store.stamp(name)
+        if meta is None:
+            # no sidecar = not a committed store entry (or a bare
+            # legacy file whose staleness nobody can judge): never
+            # serve it to a peer
+            self._send_json({"error": "no such committed page",
+                             "entry": name}, code=404)
+            return
+        fp = meta.get("fingerprint")
+        cache_key = (store.root, name, json.dumps(fp))
+        hit = _page_fresh_cache.get(cache_key)
+        if hit is not None and time.monotonic() - hit[0] \
+                < PAGE_FRESH_TTL_S:
+            fresh = hit[1]
+        else:
+            fresh = fingerprint_fresh(fp)
+            if len(_page_fresh_cache) > 1024:
+                _page_fresh_cache.clear()  # bounded, coarse
+            _page_fresh_cache[cache_key] = (time.monotonic(), fresh)
+        if fresh is False:
+            self._send_json({"error": "stale page fingerprint",
+                             "entry": name}, code=404)
+            return
+        store.pin(name)
+        try:
+            s = store.open_read(name)
+            if s is None:
+                self._send_json({"error": "no such committed page",
+                                 "entry": name}, code=404)
+                return
+            with s:
+                data = s.read_all()
+            total = len(data)
+            code = 200
+            content_range = None
+            rng = self.headers.get("Range")
+            m = re.match(r"bytes=(\d+)-(\d*)$", (rng or "").strip())
+            if m:
+                lo = int(m.group(1))
+                hi = int(m.group(2)) + 1 if m.group(2) else total
+                hi = min(hi, total)
+                if lo >= hi:
+                    self._send_json(
+                        {"error": f"unsatisfiable range {rng!r}",
+                         "size": total}, code=416)
+                    return
+                data = data[lo:hi]
+                code = 206
+                content_range = f"bytes {lo}-{hi - 1}/{total}"
+            self.send_response(code)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(data)))
+            if content_range:
+                self.send_header("Content-Range", content_range)
+            self.send_header("X-Dmlc-Fingerprint", json.dumps(fp))
+            self.send_header("X-Dmlc-Codec",
+                             str(meta.get("codec", "raw")))
+            self.end_headers()
+            self.wfile.write(data)
+            owner.registry.counter("objstore.peer.served").inc()
+            owner.registry.counter(
+                "objstore.peer.served_bytes").inc(len(data))
+        finally:
+            store.unpin(name)
+
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         url = urlparse(self.path)
         try:
@@ -356,6 +462,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                MAX_TRACE_CAPTURE_S))
                         hz = float(raw_hz) if raw_hz else None
                         self._send_json(prof.burst(seconds, hz=hz))
+            elif url.path.startswith("/pages/"):
+                self._serve_page(owner, url.path[len("/pages/"):])
             else:
                 self._send_json({"error": "unknown endpoint",
                                  "endpoints": ["/metrics",
@@ -365,7 +473,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                "/history", "/gang",
                                                "/analyze",
                                                "/profile?seconds=N"
-                                               "&hz=M"]},
+                                               "&hz=M",
+                                               "/pages/<entry>"]},
                                 code=404)
         except Exception as e:  # noqa: BLE001 — a scrape must never
             try:                # take down the serving thread
@@ -378,8 +487,13 @@ class StatusServer:
     """One daemon-thread HTTP status server for this process."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 pages_root: Optional[str] = None):
         self.registry = registry if registry is not None else REGISTRY
+        # /pages serves THIS store's committed entries (None = the
+        # process default store, resolved per request so env-driven
+        # per-rank roots apply)
+        self._pages_root = pages_root
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.status_server = self
@@ -398,6 +512,15 @@ class StatusServer:
         # the port is itself telemetry: a merged gang snapshot tells
         # the reader where each rank can be curled
         self.registry.gauge("obs.serve_port").set(self.port)
+
+    def pages_store(self):
+        """The page store /pages serves from: the explicit
+        ``pages_root``, else the process default store (hydrated
+        remote blocks live there)."""
+        from dmlc_tpu.io.pagestore import PageStore
+        if self._pages_root is not None:
+            return PageStore.at(self._pages_root)
+        return PageStore.default()
 
     def analyze_verdict(self) -> Optional[Dict[str, Any]]:
         """The /analyze payload: attribute the last completed epoch of

@@ -256,6 +256,13 @@ def launch_local(num_workers: int, command: Sequence[str],
     ``DMLC_TPU_SERVE_PORTS`` so rank 0 (or anyone) can
     ``obs.serve.scrape_gang()`` the live processes into one merged
     snapshot. Pass explicit ports when the launcher itself will scrape.
+    The same two variables ARE the gang's peer DATA plane
+    (docs/remote_io.md "Peer tier"): each rank's server also answers
+    ``/pages/<entry>``, and the objstore read path
+    (``dmlc_tpu.io.objstore.peer``) derives the gang topology from the
+    exported port list — a serving gang hydrates ``obj://`` pages from
+    its peers ahead of the wire with zero extra wiring (give each rank
+    its own ``DMLC_TPU_PAGESTORE_DIR`` when they share a host).
 
     ``flight_dir`` hands every worker the crash flight-recorder
     contract (``DMLC_TPU_FLIGHT_DIR``): workers that call

@@ -216,9 +216,16 @@ class RetryPolicy:
 # built-in per-site CHANGES (applied over whatever the CURRENT default
 # policy is at lookup time — a replaced default's sleep/backoff flows
 # through); a gang scrape should fail fast: the unreachable rank is
-# reported, not waited on through a full backoff ladder
+# reported, not waited on through a full backoff ladder. The objstore
+# peer tier retries a little HARDER than the default: a peer answering
+# 404 is usually the block's owner still mid-hydration, and a few
+# short waits are what let a non-owner pace itself behind the owner
+# instead of double-fetching from the wire (it still degrades to the
+# wire when the ladder runs out — never a hang).
 _BUILTIN_SITE_DEFAULTS: List[Tuple[str, Dict[str, Any]]] = [
     ("obs.scrape", {"max_attempts": 2, "base_delay_s": 0.05}),
+    ("io.objstore.peer", {"max_attempts": 4, "base_delay_s": 0.05,
+                          "max_delay_s": 0.5}),
 ]
 
 _lock = threading.Lock()

@@ -46,6 +46,13 @@ without the tools baked in:
   ONE sampling profiler (one trie, one budget, one /profile payload);
   a second frame-walker elsewhere would mint a parallel universe the
   watchdog, flight bundles and ``hot_frames`` evidence never see.
+- **Http-client gate** (always run, AST-based): ``http.client`` and
+  ``urllib.request`` imports inside ``dmlc_tpu/`` are confined to the
+  objstore client modules (``io/objstore/http_client.py``,
+  ``io/objstore/peer.py``) and ``obs/serve.py``'s scrape — outbound
+  HTTP elsewhere would bypass the ``io.objstore.*``/``obs.scrape``
+  retry seams, fault plans, and byte counters (the ``http.server``
+  side is pinned to ``obs/serve.py`` by the metric gate).
 - **Steady-path gate** (always run, AST-based): inside
   ``dmlc_tpu/data/`` and ``dmlc_tpu/pipeline/``, per-row Python loops
   over block payloads (``for row in …`` or ``range(<x>.size)`` index
@@ -401,6 +408,64 @@ def profile_lint(paths: List[str],
     return findings
 
 
+# Outbound HTTP is a SEAM: the objstore client modules
+# (io/objstore/http_client.py — the real ranged-GET wire client —
+# and io/objstore/peer.py — the gang /pages tier) plus obs/serve.py's
+# scrape() are the ONLY package code that speaks http.client/
+# urllib.request. Anywhere else, an ad-hoc urlopen would bypass the
+# io.objstore.*/obs.scrape retry seams, the fault plans, and the
+# byte counters that make remote traffic auditable. The list shrinks,
+# it does not grow. (urllib.parse — pure string handling — is fine
+# anywhere.)
+HTTP_CLIENT_ALLOWED = {
+    "dmlc_tpu/io/objstore/http_client.py",
+    "dmlc_tpu/io/objstore/peer.py",
+    "dmlc_tpu/obs/serve.py",
+}
+_HTTP_CLIENT_MODULES = {("http", "client"), ("urllib", "request")}
+
+
+def http_client_lint(paths: List[str],
+                     trees: Optional[dict] = None) -> List[str]:
+    """The http-client gate: ``http.client``/``urllib.request``
+    imports in dmlc_tpu/ confined to the objstore client modules and
+    obs/serve.py (see above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel in HTTP_CLIENT_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            hits = []
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    parts = tuple(a.name.split(".")[:2])
+                    if parts in _HTTP_CLIENT_MODULES:
+                        hits.append(a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                parts = tuple(node.module.split(".")[:2])
+                if parts in _HTTP_CLIENT_MODULES:
+                    hits.append(node.module)
+                elif node.module in ("http", "urllib"):
+                    for a in node.names:
+                        if (node.module, a.name) in \
+                                _HTTP_CLIENT_MODULES:
+                            hits.append(f"{node.module}.{a.name}")
+            for hit in hits:
+                findings.append(
+                    f"{rel}:{node.lineno}: {hit} outside the objstore "
+                    "client modules — outbound HTTP goes through "
+                    "io/objstore/http_client.py, io/objstore/peer.py "
+                    "or obs.serve.scrape() so retry seams, fault "
+                    "plans and byte counters apply")
+    return findings
+
+
 # the two pre-resilience "skip this file and move on" handlers (spill
 # sweeps): genuinely skip-not-retry, pinned. New code classifies and
 # retries through dmlc_tpu.resilience instead.
@@ -690,6 +755,7 @@ def main() -> int:
     findings += verdict_lint(paths, trees)
     findings += codec_lint(paths, trees)
     findings += profile_lint(paths, trees)
+    findings += http_client_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

@@ -15,7 +15,11 @@ Subcommands (all read-only; the plane stays in charge):
                  as regressions (exit 3 when any do);
 - ``history``  — a rank's ``/history`` time-series ring, summarized;
 - ``gang``     — rank 0's ``/gang`` merged gang view (per-rank
-                 reachability, gaps, rollups), summarized;
+                 reachability, gaps, rollups), summarized — including
+                 each rank's data-plane byte split (wire vs
+                 peer-served vs served-to-peers), so the objstore
+                 peer tier's 1/N wire claim is visible on one
+                 timeline;
 - ``profile``  — a rank's ``/profile`` merged Python+native
                  flamegraph: live burst (``--seconds N --hz M``) or
                  the continuous trie, summarized as a top-frame
@@ -262,6 +266,7 @@ def cmd_gang(args) -> int:
         return 0 if "ranks" in g else 2
     print(f"gang of {len(g['ports'])} (poll {g['period_s']}s, "
           f"{g['polls']} polls)")
+    data_plane = False
     for label, m in sorted(g["ranks"].items()):
         state = "UNREACHABLE" if m["unreachable"] else "up"
         gaps = len(m["gaps"])
@@ -272,12 +277,30 @@ def cmd_gang(args) -> int:
               + f"  {kept} samples"
               + (f"  last error {m['last_error']}"
                  if m["last_error"] else ""))
+        # the rank's data-plane byte split: wire GETs vs bytes served
+        # BY peers to this rank vs bytes this rank served TO peers —
+        # the peer tier's 1/N claim, readable on one timeline
+        samples = m["series"].get("samples") or []
+        v = samples[-1]["v"] if samples else {}
+        wire = v.get("counters.objstore.bytes")
+        peer = v.get("counters.objstore.peer.bytes")
+        served = v.get("counters.objstore.peer.served_bytes")
+        if any(x for x in (wire, peer, served)):
+            data_plane = True
+            print(f"    bytes: wire {_fmt(wire, 0)} · "
+                  f"peer-served {_fmt(peer, 0)} · "
+                  f"served-to-peers {_fmt(served, 0)}")
     roll = g["rollup"]["samples"]
     if roll:
         last = roll[-1]["v"]
         print(f"  rollup: reachable {last.get('gang.reachable')}/"
               f"{last.get('gang.expected')} at last poll, "
               f"{len(roll)} rollup samples")
+        if data_plane:
+            gw = last.get("sum.counters.objstore.bytes")
+            gp = last.get("sum.counters.objstore.peer.bytes")
+            print(f"  rollup bytes: wire {_fmt(gw, 0)} · "
+                  f"peer-served {_fmt(gp, 0)} across reachable ranks")
     return 0
 
 

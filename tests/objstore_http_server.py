@@ -1,0 +1,228 @@
+"""Test-side HTTP object endpoint for the real ranged-GET client.
+
+A tiny ``ThreadingHTTPServer`` speaking the dialect
+``dmlc_tpu.io.objstore.http_client.HttpObjectStoreClient`` expects —
+ranged GET (206 + Content-Range, clamped like real object stores),
+HEAD (Content-Length / ETag / X-Dmlc-Mtime-Ns), PUT, the
+``?dmlc-list=`` JSON listing convention, the optional ``dtpc``
+transfer coding, and an optional required auth header — DELEGATING
+storage and ground-truth request counters to an inner
+:class:`~dmlc_tpu.io.objstore.emulator.EmulatedObjectStore`. That
+delegation is the point: the whole emulator-backed objstore suite
+(FS surface, hydration acceptance, chaos at ``io.objstore.get``)
+reruns over the REAL wire client by swapping the configured client,
+while the emulator's counters keep proving what actually moved.
+
+Helper module, not a test module (no ``test_`` prefix); lives in
+tests/ so the ``http.server`` lint confinement (dmlc_tpu/ only) does
+not apply.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, unquote, urlparse
+
+_RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)$")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dmlc-test-objstore/1"
+
+    def log_message(self, fmt, *args):  # noqa: A002 — base signature
+        pass
+
+    # -- plumbing
+
+    def _em(self):
+        return self.server.emulator
+
+    def _auth_ok(self) -> bool:
+        required: Optional[Dict[str, str]] = self.server.require_headers
+        if not required:
+            return True
+        for name, value in required.items():
+            if self.headers.get(name) != value:
+                return False
+        return True
+
+    def _deny(self) -> None:
+        self.send_response(403)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _not_found(self) -> None:
+        self.send_response(404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _bucket_key(self):
+        parts = unquote(urlparse(self.path).path).lstrip("/").split(
+            "/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        return bucket, key
+
+    def _send_bytes(self, code: int, data: bytes,
+                    extra: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "application/octet-stream")
+        length = len(data)
+        if self.server.truncate_bodies_to is not None:
+            # torn-transfer mode: declare the full length, send less —
+            # the client's Content-Length check must catch it
+            data = data[:self.server.truncate_bodies_to]
+        self.send_header("Content-Length", str(length))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    # -- verbs
+
+    def do_GET(self):  # noqa: N802 — contract
+        if not self._auth_ok():
+            return self._deny()
+        url = urlparse(self.path)
+        bucket, key = self._bucket_key()
+        q = parse_qs(url.query)
+        if "dmlc-list" in q:
+            if not self.server.support_list:
+                return self._not_found()
+            try:
+                rows = [{"key": o.key, "size": o.size,
+                         "mtime_ns": o.mtime_ns, "etag": o.etag}
+                        for o in self._em().list(
+                            bucket, q["dmlc-list"][0])]
+            except FileNotFoundError:
+                rows = []
+            body = json.dumps(rows).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        try:
+            info = self._em().head(bucket, key, count=False)
+        except (FileNotFoundError, Exception) as e:  # noqa: B014
+            if isinstance(e, FileNotFoundError):
+                return self._not_found()
+            raise
+        rng = self.headers.get("Range")
+        m = _RANGE_RE.match((rng or "").strip())
+        start, end = 0, info.size
+        code = 206 if m else 200
+        if m:
+            start = int(m.group(1))
+            end = int(m.group(2)) + 1 if m.group(2) else info.size
+            end = min(end, info.size)  # clamp like a real object store
+            if start >= info.size and info.size:
+                self.send_response(416)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+        if self.server.ignore_range:
+            start, end, code = 0, info.size, 200
+        level = 0
+        accept_codec = self.headers.get("X-Dmlc-Accept-Codec")
+        if accept_codec == "dtpc" and self.server.support_encoded:
+            raw = self.headers.get("X-Dmlc-Codec-Level", "0")
+            level = int(raw) if raw.isdigit() else 0
+        extra = {}
+        if code == 206:
+            extra["Content-Range"] = (f"bytes {start}-{end - 1}"
+                                      f"/{info.size}")
+        if level > 0:
+            # the emulator's transfer-coding path counts ENCODED bytes
+            data = self._em().get_encoded(bucket, key, start, end,
+                                          level)
+            extra["X-Dmlc-Codec"] = "dtpc"
+        else:
+            data = self._em().get(bucket, key, start, end)
+        self._send_bytes(code, data, extra)
+
+    def do_HEAD(self):  # noqa: N802 — contract
+        if not self._auth_ok():
+            return self._deny()
+        bucket, key = self._bucket_key()
+        try:
+            info = self._em().head(bucket, key)
+        except FileNotFoundError:
+            return self._not_found()
+        self.send_response(200)
+        self.send_header("Content-Length", str(info.size))
+        if not self.server.no_change_token:
+            self.send_header("ETag", f'"{info.etag}"')
+            self.send_header("X-Dmlc-Mtime-Ns", str(info.mtime_ns))
+        self.end_headers()
+
+    def do_PUT(self):  # noqa: N802 — contract
+        if not self._auth_ok():
+            return self._deny()
+        bucket, key = self._bucket_key()
+        length = int(self.headers.get("Content-Length", "0") or "0")
+        body = self.rfile.read(length)
+        self._em().put(bucket, key, body)
+        self.send_response(201)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class ObjstoreHttpServer:
+    """The test endpoint: ``.endpoint`` for the client, ``.emulator``
+    for ground truth. Knobs (set between requests):
+
+    - ``require_headers`` — auth headers every request must carry;
+    - ``ignore_range`` — act like a Range-ignoring server (200 + full
+      body);
+    - ``truncate_bodies_to`` — declare full Content-Length but send
+      only N bytes (torn transfer);
+    - ``support_list`` / ``support_encoded`` — advertise the listing
+      convention / dtpc transfer coding;
+    - ``no_change_token`` — omit ETag/X-Dmlc-Mtime-Ns on HEAD (a
+      plain endpoint with no change tokens).
+    """
+
+    def __init__(self, emulator, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, 0), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.emulator = emulator
+        self._httpd.require_headers = None
+        self._httpd.ignore_range = False
+        self._httpd.truncate_bodies_to = None
+        self._httpd.support_list = True
+        self._httpd.support_encoded = True
+        self._httpd.no_change_token = False
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.endpoint = f"http://{host}:{self.port}"
+        self.emulator = emulator
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="tests.objstore_http_server")
+        self._thread.start()
+
+    def __getattr__(self, name):
+        if name in ("require_headers", "ignore_range",
+                    "truncate_bodies_to", "support_list",
+                    "support_encoded", "no_change_token"):
+            return getattr(self._httpd, name)
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name in ("require_headers", "ignore_range",
+                    "truncate_bodies_to", "support_list",
+                    "support_encoded", "no_change_token"):
+            setattr(self._httpd, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)

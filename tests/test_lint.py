@@ -312,3 +312,31 @@ class TestLintGate:
         finally:
             os.remove(probe)
         assert findings == [], "\n".join(findings)
+
+    def test_http_client_gate_clean(self):
+        # http.client/urllib.request imports in dmlc_tpu/ confined to
+        # the objstore client modules + obs/serve.py's scrape
+        findings = lint.http_client_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_http_client_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe9.py")
+        with open(bad, "w") as f:
+            f.write("import urllib.request\n"
+                    "import http.client\n"
+                    "from urllib.request import urlopen\n"
+                    "from http import client\n"
+                    "from urllib.parse import urlparse\n")  # fine
+        try:
+            findings = lint.http_client_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 4, "\n".join(findings)
+        assert all("objstore client modules" in f for f in findings)
+
+    def test_http_client_gate_allows_client_modules(self):
+        for rel in ("io/objstore/http_client.py", "io/objstore/peer.py",
+                    "obs/serve.py"):
+            path = os.path.join(lint.REPO, "dmlc_tpu",
+                                *rel.split("/"))
+            assert lint.http_client_lint([path]) == [], rel

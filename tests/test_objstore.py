@@ -2,7 +2,14 @@
 ``obj://`` FileSystem surface, ranged-GET coalescing, page-store
 hydration — and THE acceptance: byte-identical epochs vs local reads,
 a wire-free second epoch proven by GET counters, and chaos runs that
-still complete byte-identical through the retry seams."""
+still complete byte-identical through the retry seams.
+
+The whole suite is PARAMETRIZED over the wire client: the on-disk
+emulator directly, and the REAL stdlib HTTP ranged-GET client
+(``io/objstore/http_client.py``) speaking to a test HTTP endpoint
+that delegates storage + ground-truth counters to an inner emulator —
+the same FS-surface and retry-seam behavior, byte for byte, over a
+real socket."""
 
 import os
 
@@ -25,24 +32,63 @@ def _counter(name):
     return REGISTRY.counter(name).value
 
 
-@pytest.fixture
-def em(tmp_path, monkeypatch):
-    """A fresh emulator client + an isolated page store root, with the
-    process-global client/options restored afterwards."""
+class _HttpBackendHandle:
+    """The parametrized suite's handle for the HTTP backend: object
+    VERBS go through the real wire client (that is the parity under
+    test), ground truth — request counters, the on-disk root — stays
+    with the inner emulator behind the test endpoint."""
+
+    def __init__(self, client, inner):
+        self._client = client
+        self._inner = inner
+        self.root = inner.root
+
+    def counters(self):
+        return self._inner.counters()
+
+    def reset_counters(self):
+        return self._inner.reset_counters()
+
+    def __getattr__(self, name):
+        return getattr(self._client, name)
+
+
+@pytest.fixture(params=["emulator", "http"])
+def em(request, tmp_path, monkeypatch):
+    """A fresh backend client + an isolated page store root, with the
+    process-global client/options restored afterwards. Runs twice:
+    once on the emulator, once on the real HTTP ranged-GET client in
+    front of an emulator-backed test endpoint."""
     import dmlc_tpu.io.objstore.fs as ofs
     import dmlc_tpu.io.pagestore as ps
     monkeypatch.delenv(ofs.ENV_ROOT, raising=False)
     monkeypatch.setattr(ps, "default_store_dir",
                         lambda: str(tmp_path / "pagestore"))
     saved = ofs.options()
-    client = objstore.configure(root=str(tmp_path / "objroot"),
-                                block_bytes=1 << 15, coalesce=4,
-                                parallel=2)
-    yield client
+    server = None
+    from dmlc_tpu.io.objstore.emulator import EmulatedObjectStore
+    inner = EmulatedObjectStore(str(tmp_path / "objroot"))
+    if request.param == "emulator":
+        handle = objstore.configure(inner, block_bytes=1 << 15,
+                                    coalesce=4, parallel=2)
+    else:
+        from objstore_http_server import ObjstoreHttpServer
+
+        from dmlc_tpu.io.objstore.http_client import (
+            HttpObjectStoreClient,
+        )
+        server = ObjstoreHttpServer(inner)
+        client = HttpObjectStoreClient(server.endpoint, encoded=True)
+        objstore.configure(client, block_bytes=1 << 15, coalesce=4,
+                           parallel=2)
+        handle = _HttpBackendHandle(client, inner)
+    yield handle
     objstore.configure(None, block_bytes=saved["block_bytes"],
                        coalesce=saved["coalesce"],
                        parallel=saved["parallel"],
                        hydrate=saved["hydrate"])
+    if server is not None:
+        server.close()
     inject.uninstall()
     reset_policies()
 
