@@ -31,7 +31,7 @@ Prints exactly ONE JSON line: {"metric", "value", "unit",
 "sustained_gauge_ok", "gauge_ok_epochs", "gauge_ok_threshold",
 "epoch_gauges", "gauge_bands", "run_band", "replay_gbps", "replay",
 "replay_tier", "handwired_gbps", "pipeline", "metrics", "analysis",
-"trace"} —
+"control", "trace"} —
 "value" is the SUSTAINED rate (20%-trimmed mean of per-epoch GB/s over
 >= 5 epochs / >= the time budget), "best_epoch" the fastest single
 epoch, "parse_cpu_gbps_core" the thread-CPU parse rate (immune to this
@@ -149,6 +149,15 @@ def main() -> None:
     if _profile.install_if_env() is None \
             and os.environ.get(_profile.ENV_PROFILE_HZ) is None:
         _profile.install()
+    # the verdict-driven controller is DEFAULT-ON for bench runs (env
+    # wins: DMLC_TPU_CONTROL=0 disables): the measurement pipeline's
+    # knobs move against the /analyze verdict instead of the blind
+    # hill-climber, and every decision lands in the ledger embedded
+    # under "control" — campaigns record WHAT moved and WHY
+    from dmlc_tpu.obs import control as _ctl
+    if _ctl.install_if_env() is None \
+            and os.environ.get(_ctl.ENV_CONTROL) is None:
+        _ctl.install()
     import jax
     import numpy as np
     from dmlc_tpu.data.parser import Parser
@@ -312,6 +321,10 @@ def main() -> None:
     i = 0
     while True:
         gauge = memcpy_gauge()
+        if _ctl.active() is not None:
+            # the controller judges the climate from the same gauge
+            # the bands are built on — a drained bucket FREEZES knobs
+            _ctl.active().note_gauge(gauge)
         dt, t_pull, t_xfer, t_asm, rows, nnz, stats, snap = epoch()
         times.append((dt, gauge))
         log(f"epoch {i}: rows={rows} nnz={nnz} wall={dt:.2f}s "
@@ -388,6 +401,11 @@ def main() -> None:
         if line:
             log(line)
     autotune_report = built.autotune_report()
+    if _ctl.active() is not None:
+        # the controller subsumed the autotuner: knob moves belong to
+        # the "control" ledger below — reporting them as autotuner
+        # work would credit a tuner that never ran
+        autotune_report = None
     built.close()
     if autotune_report:
         log(f"autotune: values={autotune_report['values']} "
@@ -499,6 +517,12 @@ def main() -> None:
         log(f"analysis: bound={analysis['bound']} "
             f"({analysis['confidence']}) — "
             + "; ".join(analysis["evidence"][:3]))
+    control_doc = None
+    if _ctl.active() is not None:
+        try:
+            control_doc = _ctl.active().to_dict(last=32)
+        except Exception as e:  # noqa: BLE001 — the campaign line
+            log(f"control ledger excerpt failed: {e}")  # must survive
     print(json.dumps({
         "metric": "libsvm_parse_to_hbm_throughput",
         "value": round(sustained, 4),
@@ -546,7 +570,8 @@ def main() -> None:
         "handwired_gbps": handwired_gbps,
         # the pipeline-built config's best epoch, per stage (schema:
         # dmlc_tpu.pipeline.stats) + the between-epoch autotune report
-        # — the in-flight device window is tuner-owned, not a constant
+        # — null when the verdict-driven controller owned the knobs
+        # instead (its moves ride the "control" ledger below)
         "pipeline": {
             "stages": best_snap["stages"] if best_snap else None,
             "knobs": best_snap["knobs"] if best_snap else None,
@@ -560,6 +585,14 @@ def main() -> None:
         # bound/band/confidence/evidence/stage_waits — what obsctl
         # diagnose prints and the /analyze endpoint serves live
         "analysis": analysis,
+        # the control plane's decision-ledger excerpt (schema:
+        # dmlc_tpu.obs.control.CONTROL_SCHEMA): which knobs moved,
+        # on which verdicts, with the evidence — what /control serves
+        # live and obsctl control renders; null when the controller
+        # was disabled (DMLC_TPU_CONTROL=0) or its payload failed
+        # (to_dict runs knob closures; a raising one must not cost
+        # the whole campaign line — the flight.py discipline)
+        "control": control_doc,
         # Chrome/Perfetto trace of the measurement epochs (--trace)
         "trace": trace_path,
     }))

@@ -42,6 +42,16 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                non-empty hot_frames function-level evidence from
                dmlc_tpu.obs.profile; the verdict rides in the JSON
                under "analysis"
+ 14. recio_native — ABI-6 native dense-RecordIO decode vs the Python
+               golden vs the sharded gang, sha256-parity pinned
+ 15. peer_hydrate — REAL 2-process gang peer page-store hydration
+               (each rank's cold wire bytes ≈ corpus/N, warm wire-free)
+ 16. control — the verdict-driven control plane's acceptance probe
+               (dmlc_tpu.obs.control): a parse-bound epoch sequence
+               where the controller raises the native shard count
+               against the verdict, every decision lands schema-valid
+               in the ledger, and reverts stay within the revert
+               budget (throughput never silently regresses past it)
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 
@@ -1347,6 +1357,115 @@ def bench_peer_hydrate(mb: int) -> Dict:
             "hash": local_hash}
 
 
+def bench_control(mb: int) -> Dict:
+    """Config 16 (the control PR): the verdict-driven control plane's
+    acceptance probe. A parse-bound pipeline (criteo-shaped corpus,
+    parse → padded batch, trivially fast consumer) runs several epochs
+    under a :class:`dmlc_tpu.obs.control.Controller` whose parse
+    family owns a REAL shard-count knob (the setter rebuilds the
+    pipeline with ``parse(shards=N)`` between epochs — the native
+    sharded single-file parse from config 12). Acceptance: the
+    verdict attributes the epochs parse-bound, the controller RAISES
+    the shard count against it (native engine; the python golden has
+    no shard headroom and must produce an honest no-op instead),
+    every decision is present and schema-valid in the ledger
+    (RECORD_KEYS), and reverted trials stay within the revert budget
+    — the rail's guarantee that measured throughput never silently
+    regresses past it."""
+    from dmlc_tpu import native
+    from dmlc_tpu.obs import control as obs_control
+    from dmlc_tpu.pipeline import Pipeline
+
+    path = f"{_TMP}.criteo.libsvm"
+    size = make_libsvm(path, mb, seed=7, nnz_range=(25, 45),
+                       index_space=10 ** 6, real_values=True)
+    rows = 8 << 10
+    nnz_bucket = rows * 45
+    have_native = native.native_available()
+    state = {"shards": 1, "built": None}
+
+    def build():
+        kw = {"shards": state["shards"]} if state["shards"] > 1 else {}
+        return (Pipeline.from_uri(path)
+                .parse(format="libsvm",
+                       engine="native" if have_native else "python",
+                       **kw)
+                .batch(rows, pad=True, nnz_bucket=nnz_bucket)
+                .build())
+
+    def set_shards(n: int) -> None:
+        if n != state["shards"]:
+            state["shards"] = n
+            state["built"].close()
+            state["built"] = build()
+
+    state["built"] = build()
+    knob = obs_control.ControlKnob(
+        "parse.shards", "parse",
+        get=lambda: state["shards"], set=set_shards,
+        lo=1, hi=2 if have_native else 1)
+    # one mover per process: a suite-wide DMLC_TPU_CONTROL controller
+    # would adopt the probe pipeline and trial ITS knobs mid-probe,
+    # perturbing the walls this probe's own rail judges — suspend it
+    # BEFORE building the probe controller (so the probe owns the
+    # "control" collector name too), reinstall after
+    suspended = obs_control.detach()
+    ctl = obs_control.Controller([knob], revert_budget=1)
+    walls: List[float] = []
+    try:
+        for _ in range(5):
+            snap = state["built"].run_epoch()
+            walls.append(snap["wall_s"])
+            ctl.observe(snap)
+        records = ctl.ledger.records()
+        doc = ctl.to_dict()
+    finally:
+        state["built"].close()
+        ctl.close()
+        if suspended is not None:
+            obs_control.install(suspended)
+    assert records, "controller made no decisions over 5 epochs"
+    for rec in records:
+        assert sorted(rec) == sorted(obs_control.RECORD_KEYS), \
+            f"ledger record drifted from RECORD_KEYS: {sorted(rec)}"
+        assert rec["verdict_id"], "decision without a citable verdict"
+        assert rec["evidence"], "decision without measured evidence"
+    bounds = [r["bound"] for r in records]
+    assert "parse" in bounds, \
+        f"epochs never attributed parse-bound: {bounds}"
+    trials = [r for r in records if r["outcome"] == "trial"]
+    reverts = [r for r in records if r["outcome"] == "reverted"]
+    assert len(reverts) <= 1, \
+        f"{len(reverts)} reverts exceed the revert budget of 1"
+    if have_native:
+        # the observe→act acceptance: a parse-bound verdict RAISED the
+        # shard count (a later revert is legal — the rail's job — but
+        # the move must have been made and the knob must equal what
+        # the ledger says it should)
+        assert any(t["knob"] == "parse.shards" and t["new"] > t["old"]
+                   for t in trials), f"shards never raised: {records}"
+    else:
+        assert not trials, "python engine has no shard headroom"
+    expected = knob.initial
+    for r in records:
+        if r["knob"] == "parse.shards" and r["outcome"] == "trial":
+            expected = r["new"]
+        elif r["knob"] == "parse.shards" and r["outcome"] in (
+                "reverted", "discarded"):
+            expected = r["old"]  # the move was undone: back at old
+    assert state["shards"] == expected, \
+        (f"knob value {state['shards']} disagrees with the ledger's "
+         f"account {expected}")
+    return {"config": "control", "gbps": size / min(walls) / 1e9,
+            "bytes": size, "epochs": len(walls),
+            "epoch_walls": [round(w, 3) for w in walls],
+            "shards_final": state["shards"],
+            "decisions": len(records),
+            "trials": len(trials), "reverted": len(reverts),
+            "counts": doc["counts"],
+            "ledger": records[-8:]}
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -1363,13 +1482,14 @@ CONFIGS = {
     13: ("analyze", lambda mb, dev: bench_analyze(mb)),
     14: ("recio_native", lambda mb, dev: bench_recio_native(mb)),
     15: ("peer_hydrate", lambda mb, dev: bench_peer_hydrate(mb)),
+    16: ("control", lambda mb, dev: bench_control(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-15 (0 = all)")
+                    help="1-16 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -1400,6 +1520,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     # (/metrics, /healthz), DMLC_TPU_FLIGHT_DIR leaves a post-mortem
     # bundle if a config dies badly
     from dmlc_tpu.obs.aggregate import install_if_env as _gang_if_env
+    from dmlc_tpu.obs.control import install_if_env as _ctl_if_env
     from dmlc_tpu.obs.flight import install_if_env
     from dmlc_tpu.obs.profile import install_if_env as _prof_if_env
     from dmlc_tpu.obs.serve import serve_if_env
@@ -1413,6 +1534,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     install_if_env()
     _gang_if_env()
     _prof_if_env()    # DMLC_TPU_PROFILE_HZ: /profile flamegraphs
+    _ctl_if_env()     # DMLC_TPU_CONTROL: verdict-driven controller
     picks = [args.config] if args.config else sorted(CONFIGS)
     for n in picks:
         name, fn = CONFIGS[n]
@@ -1429,8 +1551,11 @@ def main(argv: Optional[List[str]] = None) -> None:
             # interleaves 3 native epochs per contender (self-warming —
             # and its python-golden leg is ~100x the native one, so a
             # warm pass would double the slowest part of the suite)
-            # ... and config 15's gang manages its own cold/warm split
-            if not args.cold and n not in (7, 8, 9, 10, 11, 13, 14, 15):
+            # ... and config 15's gang manages its own cold/warm split;
+            # config 16's controller probe runs its own epoch sequence
+            # (a warm pass would pre-move the knobs it asserts on)
+            if not args.cold and n not in (7, 8, 9, 10, 11, 13, 14,
+                                           15, 16):
                 fn(args.mb, args.device)  # warm imports + page cache
             trace_path = None
             if args.trace:

@@ -47,7 +47,14 @@ ENV_GANG_POLL_S = "DMLC_TPU_GANG_POLL_S"
 # transition plus the most recent ones tell the whole story
 MAX_GAPS = 64
 
+# knob VALUES are identities, not quantities — summing rank 0's queue
+# depth with rank 1's reads as nonsense on the rollup timeline (the
+# per-rank series still carry them; obsctl gang reads those).
+# Control collectors may be name-suffixed ("control#2" when two
+# controllers coexist), so their knob leaves are matched by the pair
+# below, not a plain prefix.
 _ROLLUP_SKIP_SECTIONS = ("collectors.pipeline.knobs",)
+_ROLLUP_SKIP_PAIRS = (("collectors.control", ".knobs."),)
 
 
 class _Member:
@@ -157,6 +164,9 @@ class GangAggregator:
             keys.update(leaves)
         for key in keys:
             if key.startswith(_ROLLUP_SKIP_SECTIONS):
+                continue
+            if any(key.startswith(p) and mid in key
+                   for p, mid in _ROLLUP_SKIP_PAIRS):
                 continue
             vals = [lv[key] for lv in per_rank if key in lv]
             if not vals:

@@ -9,10 +9,15 @@ records — ``StageProbe`` wait totals, the device stage's
 ``assemble_s``, pagestore/objstore hit counters, and the credit-gauge
 bands bench.py computes — into one structured verdict:
 
-``{"schema": 2, "bound": "parse" | "assemble" | "xfer" | "wire" |
-"credit-limited" | "consumer", "band": <credit band>, "confidence":
-"high" | "medium" | "low", "evidence": [...], "hot_frames": [...],
-"stage_waits": {...}}``
+``{"schema": 3, "epoch": <monotonic>, "verdict_id": "v<epoch>-<digest>",
+"bound": "parse" | "assemble" | "xfer" | "wire" | "credit-limited" |
+"consumer", "band": <credit band>, "confidence": "high" | "medium" |
+"low", "evidence": [...], "hot_frames": [...], "stage_waits": {...}}``
+
+``epoch``/``verdict_id`` (schema 3) make verdicts citable: the epoch
+is the snapshot's monotonic counter and the id digests what was
+judged, so a control-plane ledger record (:mod:`dmlc_tpu.obs.control`)
+can reference the EXACT verdict that moved a knob.
 
 ``hot_frames`` (schema 2) is function-level evidence from the
 sampling profiler (:mod:`dmlc_tpu.obs.profile`) when one is
@@ -38,6 +43,7 @@ regression.
 
 from __future__ import annotations
 
+import hashlib as _hashlib
 import json
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -46,13 +52,15 @@ __all__ = ["attribute", "compare", "compare_files", "load_bench",
            "ANALYSIS_SCHEMA", "DEFAULT_TOLERANCE"]
 
 # bump when the verdict's top-level shape changes incompatibly
-# (2: hot_frames — sampling-profiler function-level evidence)
-ANALYSIS_SCHEMA = 2
+# (2: hot_frames — sampling-profiler function-level evidence;
+#  3: epoch + verdict_id — the control ledger back-references the
+#  exact verdict that moved a knob)
+ANALYSIS_SCHEMA = 3
 
 # the verdict's pinned key set — scripts/lint.py's verdict-schema gate
 # checks every literal verdict dict in the package against this tuple
-VERDICT_KEYS = ("schema", "bound", "band", "confidence", "evidence",
-                "hot_frames", "stage_waits")
+VERDICT_KEYS = ("schema", "epoch", "verdict_id", "bound", "band",
+                "confidence", "evidence", "hot_frames", "stage_waits")
 
 BOUNDS = ("parse", "assemble", "xfer", "wire", "credit-limited",
           "consumer")
@@ -153,7 +161,8 @@ def attribute(pipeline_snap: Dict[str, Any],
               metrics: Optional[Dict[str, Any]] = None,
               epoch_gauges: Optional[List[float]] = None,
               run_band: Optional[str] = None,
-              profile_doc: Optional[Dict[str, Any]] = None
+              profile_doc: Optional[Dict[str, Any]] = None,
+              epoch: Optional[int] = None
               ) -> Dict[str, Any]:
     """Decompose one epoch into a bound verdict.
 
@@ -168,6 +177,11 @@ def attribute(pipeline_snap: Dict[str, Any],
     optional :mod:`dmlc_tpu.obs.profile` ``to_dict()`` payload for
     the ``hot_frames`` evidence; when omitted, the process's
     installed sampling profiler (if any) is read.
+
+    ``epoch`` (schema 3) defaults to the snapshot's own monotonic
+    epoch counter; with it the verdict carries a stable
+    ``verdict_id`` (epoch + a content digest), so a control-ledger
+    record can reference the EXACT verdict that moved a knob.
     """
     stages = list(pipeline_snap.get("stages") or [])
     wall = float(pipeline_snap.get("wall_s") or 0.0)
@@ -336,21 +350,35 @@ def attribute(pipeline_snap: Dict[str, Any],
             f"{label}: "
             + ", ".join(f"{h['frame']} {h['frac']:.0%}"
                         for h in hot[:3]))
+    if epoch is None:
+        try:
+            epoch = int(pipeline_snap.get("epoch") or 0)
+        except (TypeError, ValueError):
+            epoch = 0
+    stage_waits = {
+        "parse_s": round(parse_s, 6),
+        "assemble_s": round(assemble_s, 6),
+        "xfer_s": round(xfer_s, 6),
+        "total_wait_s": round(total_wait, 6),
+        "wall_s": round(wall, 6),
+        "stages": per_stage,
+    }
+    # stable id: the monotonic epoch + a digest of what was judged —
+    # two verdicts over the same measurements share an id, a ledger
+    # record can reference exactly the verdict that moved its knob
+    digest = _hashlib.sha256(json.dumps(
+        [epoch, bound, band, stage_waits],
+        sort_keys=True).encode()).hexdigest()[:10]
     return {
         "schema": ANALYSIS_SCHEMA,
+        "epoch": epoch,
+        "verdict_id": f"v{epoch}-{digest}",
         "bound": bound,
         "band": band,
         "confidence": confidence,
         "evidence": evidence,
         "hot_frames": hot,
-        "stage_waits": {
-            "parse_s": round(parse_s, 6),
-            "assemble_s": round(assemble_s, 6),
-            "xfer_s": round(xfer_s, 6),
-            "total_wait_s": round(total_wait, 6),
-            "wall_s": round(wall, 6),
-            "stages": per_stage,
-        },
+        "stage_waits": stage_waits,
     }
 
 

@@ -44,6 +44,9 @@ Bundle layout (one timestamped dir per process under ``out_dir``)::
                       # when dmlc_tpu.obs.profile is installed)
       faults.json     # armed fault plan + injected-fault log (only
                       # when dmlc_tpu.resilience.inject chaos was on)
+      control.json    # the verdict-driven controller's decision
+                      # ledger + knob state (only when
+                      # dmlc_tpu.obs.control is installed)
 
 Wiring: ``install()`` / ``uninstall()`` directly, or
 :func:`install_if_env` under ``DMLC_TPU_FLIGHT_DIR`` (set per worker
@@ -314,6 +317,20 @@ class FlightRecorder:
                     wrote["profile.txt"] = "ok"
                 except Exception as e:  # noqa: BLE001
                     wrote["profile.txt"] = f"failed: {e!r}"
+            # the control plane's decision ledger: a post-mortem that
+            # says WHICH knob moved on WHAT evidence before the death.
+            # to_dict() runs user knob closures — guarded, because a
+            # raising knob must cost this SECTION, never the bundle
+            try:
+                from dmlc_tpu.obs import control as _control
+                ctl = _control.active()
+                control_doc = (ctl.to_dict() if ctl is not None
+                               else None)
+            except Exception as e:  # noqa: BLE001 — optional section
+                control_doc = None
+                wrote["control.json"] = f"failed: {e!r}"
+            if control_doc is not None:
+                _write_json("control.json", control_doc)
             try:
                 from dmlc_tpu.resilience import inject as _inject
                 plan = _inject.active()

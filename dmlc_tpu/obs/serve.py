@@ -32,6 +32,11 @@ rank serves:
 - ``GET /analyze`` — a bottleneck-attribution verdict
   (:mod:`dmlc_tpu.obs.analyze`) over the last completed pipeline
   epoch's stage stats + the current registry snapshot;
+- ``GET /control[?last=N]`` — the verdict-driven controller's state
+  and decision ledger (:mod:`dmlc_tpu.obs.control`): every knob move,
+  freeze, and no-op with the verdict evidence that caused it (404
+  with an enable hint until a controller is installed, like
+  ``/history``);
 - ``GET /profile[?seconds=N&hz=M]`` — the sampling profiler's merged
   Python+native flamegraph (:mod:`dmlc_tpu.obs.profile`): the
   continuous trie, or an on-demand burst capture of the next N
@@ -429,6 +434,21 @@ class _Handler(BaseHTTPRequestHandler):
                     raw = q.get("seconds", [None])[0]
                     last_s = float(raw) if raw else None
                     self._send_json(agg.view(last_s=last_s))
+            elif url.path == "/control":
+                from dmlc_tpu.obs import control as _control
+                ctl = _control.active()
+                if ctl is None:
+                    self._send_json(
+                        {"error": "no controller installed",
+                         "hint": "set DMLC_TPU_CONTROL=1 (launch_"
+                                 "local(control=True)) or call "
+                                 "obs.control.install()"},
+                        code=404)
+                else:
+                    q = parse_qs(url.query)
+                    raw = q.get("last", [None])[0]
+                    last = int(raw) if raw else None
+                    self._send_json(ctl.to_dict(last=last))
             elif url.path == "/analyze":
                 verdict = owner.analyze_verdict()
                 if verdict is None:
@@ -472,6 +492,7 @@ class _Handler(BaseHTTPRequestHandler):
                                                "/trace?seconds=N",
                                                "/history", "/gang",
                                                "/analyze",
+                                               "/control[?last=N]",
                                                "/profile?seconds=N"
                                                "&hz=M",
                                                "/pages/<entry>"]},

@@ -56,6 +56,8 @@ ENV_HISTORY_S = "DMLC_TPU_HISTORY_S"      # time-series sample period
 ENV_GANG_POLL_S = "DMLC_TPU_GANG_POLL_S"  # rank-0 gang-poll period
 ENV_PROFILE_HZ = "DMLC_TPU_PROFILE_HZ"    # sampling-profiler rate
 #   (launch_local(profile_hz=...); obs.profile.install_if_env())
+ENV_CONTROL = "DMLC_TPU_CONTROL"          # verdict-driven controller
+#   (launch_local(control=True); obs.control.install_if_env())
 # resilience contracts (dmlc_tpu.resilience): launch_local(faults=...)
 # sets DMLC_TPU_FAULTS for every member; the gang supervisor sets
 # DMLC_TPU_ATTEMPT (alias DMLC_NUM_ATTEMPT — the reference's rejoin
@@ -209,6 +211,7 @@ def launch_local(num_workers: int, command: Sequence[str],
                  history_s: Optional[float] = None,
                  gang_poll_s: Optional[float] = None,
                  profile_hz: Optional[float] = None,
+                 control: Optional[bool] = None,
                  restart_policy=None,
                  faults=None) -> List[int]:
     """Run N worker processes on this host (reference: local.py).
@@ -289,6 +292,15 @@ def launch_local(num_workers: int, command: Sequence[str],
     that rate — merged Python+native flamegraphs served at
     ``/profile``, attached to stall reports and crash bundles
     (``profile.txt``), and feeding ``hot_frames`` verdict evidence.
+
+    ``control=True`` hands every worker the verdict-driven control
+    plane (``DMLC_TPU_CONTROL``): workers that call
+    ``obs.control.install_if_env()`` run the between-epoch controller
+    — the ``/analyze`` verdict picks WHICH knob family moves, every
+    decision (including freezes and no-ops) lands in the per-rank
+    decision ledger served at ``/control``, rendered by ``obsctl
+    control``, aggregated gang-wide, and attached to flight bundles
+    as ``control.json``.
 
     Returns the list of exit codes (workers first in task-id order,
     then scheduler, then servers). Raises if any process fails.
@@ -372,6 +384,8 @@ def launch_local(num_workers: int, command: Sequence[str],
             wenv[ENV_GANG_POLL_S] = str(gang_poll_s)
         if profile_hz is not None:
             wenv[ENV_PROFILE_HZ] = str(profile_hz)
+        if control:
+            wenv[ENV_CONTROL] = "1"
         if ps_root is not None:
             wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
                                 num_servers, "worker", task_id))

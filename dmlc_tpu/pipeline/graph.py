@@ -891,6 +891,9 @@ class CompiledPipeline:
         self.autotuner = autotuner
         self._epoch = 0
         self._last: Optional[Dict[str, Any]] = None
+        # one-way hand-off flag: a controller that raised on this
+        # pipeline never gets it back (see the epoch hook below)
+        self._control_failed = False
         # the pipeline's stats() registers as an obs metrics collector:
         # one REGISTRY.snapshot() sees the last epoch's stage stats
         # next to queue/engine/profiler surfaces (docs/observability.md)
@@ -916,7 +919,53 @@ class CompiledPipeline:
         self._epoch += 1
         self._last = snapshot([r.probe for r in self._runners], wall,
                               self._epoch, self.knob_values())
-        if self.autotuner is not None:
+        # one mover per process: an installed verdict-driven
+        # controller (obs.control) adopts this pipeline's knobs and
+        # subsumes the blind hill-climber — the bound verdict picks
+        # WHICH family moves; otherwise the bound autotuner takes its
+        # between-epoch step as before
+        ctl = None
+        if not self._control_failed:
+            try:
+                from dmlc_tpu.obs import control as _control
+                ctl = _control.active()
+            except Exception:  # noqa: BLE001 — telemetry never kills
+                ctl = None
+        if ctl is not None:
+            if self.autotuner is not None \
+                    and self.autotuner.rail.pending is not None:
+                # a controller installed MID-RUN takes over from the
+                # autotuner: its in-flight trial would never be judged
+                # again — discard it (value restored, no freeze) so no
+                # knob is stranded at an unjudged trial value
+                self.autotuner.rail.discard()
+            try:
+                ctl.observe_pipeline(self, self._last)
+            except Exception as e:  # noqa: BLE001 — a controller bug
+                # must not take down the epoch loop, and it must not
+                # SILENTLY disable tuning either. The hand-off is
+                # ONE-WAY (this pipeline never returns to the
+                # controller): alternating movers would let the
+                # autotuner arm a trial the controller's epoch never
+                # resolves — a knob stranded at an unjudged value
+                from dmlc_tpu.obs.log import warn_limited
+                warn_limited(
+                    "control-observe-failed",
+                    f"obs.control: observe_pipeline failed ({e!r}); "
+                    "this pipeline falls back to its own autotuner "
+                    "permanently",
+                    min_interval_s=60)
+                self._control_failed = True
+                try:
+                    # release this pipeline's controller state: an
+                    # unresolved pending trial would wedge every
+                    # OTHER source into no-ops, and the controller
+                    # must stop moving knobs the autotuner now owns
+                    ctl.abandon_pipeline(self)
+                except Exception:  # noqa: BLE001
+                    pass
+                ctl = None
+        if ctl is None and self.autotuner is not None:
             self.autotuner.after_epoch(self._last)
 
     def run_epoch(self) -> Dict[str, Any]:

@@ -35,6 +35,13 @@ without the tools baked in:
   ``scripts/`` must match it exactly — the ``/analyze`` endpoint,
   bench JSON ``"analysis"`` blocks, and ``scripts/obsctl.py`` can
   never drift apart.
+- **Knob gate** (always run, AST-based): steady-state knob mutation —
+  ``.set_capacity()`` calls, ``.prefetch_depth``/``.window`` attribute
+  assignment, ``objstore.configure()`` with coalesce/parallel/
+  codec_level — is confined to the exploration rails
+  (``pipeline/autotune.py`` + ``obs/control.py``) plus the pinned
+  modules that DEFINE the knobs, so every knob move lands in the
+  control plane's decision ledger with the evidence that caused it.
 - **Codec gate** (always run, AST-based): direct ``zlib``/``gzip``/
   ``bz2``/``lzma`` imports inside ``dmlc_tpu/`` are forbidden outside
   ``io/codec.py`` (the one compressed-page seam; the pinned exception:
@@ -632,8 +639,8 @@ def row_loop_lint(paths: List[str],
 # 13's acceptance assert, and scripts/obsctl.py all read THIS key set.
 # The pin below is the one source of truth the gate checks everything
 # against — change the schema by changing both, consciously.
-VERDICT_KEYS = ("schema", "bound", "band", "confidence", "evidence",
-                "hot_frames", "stage_waits")
+VERDICT_KEYS = ("schema", "epoch", "verdict_id", "bound", "band",
+                "confidence", "evidence", "hot_frames", "stage_waits")
 _ANALYZE_REL = "dmlc_tpu/obs/analyze.py"
 
 
@@ -693,7 +700,11 @@ def verdict_lint(paths: List[str],
                 keys = _const_str_keys(node)
                 if (keys is not None and "bound" in keys
                         and "evidence" in keys
+                        and "outcome" not in keys
                         and sorted(keys) != sorted(VERDICT_KEYS)):
+                    # ("outcome" marks a control-plane DECISION record
+                    # — it cites a verdict by id, it is not one; its
+                    # shape is pinned by obs/control.py RECORD_KEYS)
                     findings.append(
                         f"{rel}:{node.lineno}: verdict-shaped dict "
                         f"with keys {sorted(keys)} != the pinned "
@@ -703,6 +714,111 @@ def verdict_lint(paths: List[str],
     if any(rel == _ANALYZE_REL for rel, _ in scan) and not pin_seen:
         findings.append(f"{_ANALYZE_REL}:0: VERDICT_KEYS tuple "
                         "missing (the verdict-schema gate pins it)")
+    return findings
+
+
+# Knob mutation is a PLANE, not a call-site choice: every steady-state
+# tunable (queue capacities via set_capacity, the shard serve depth,
+# the in-flight device window, the objstore coalesce/parallel/codec
+# options) is moved ONLY by the exploration rails — the depth
+# hill-climber (pipeline/autotune.py) and the verdict-driven
+# controller (obs/control.py) — so every move lands in the decision
+# ledger with the evidence that caused it. A direct set_capacity or
+# configure(coalesce=...) elsewhere in the package would be a
+# hand-tuned constant the /control surface never saw. Pinned
+# exceptions: the modules that DEFINE the knobs (threaded_iter's
+# set_capacity itself, graph.py's knob get/set closures and stage
+# construction, sharded.py's initial depth) and pagestore's budget
+# plumbing. The list shrinks, it does not grow.
+KNOB_MUTATION_ALLOWED = {
+    "dmlc_tpu/pipeline/autotune.py",   # the hill-climber (rails)
+    "dmlc_tpu/obs/control.py",         # the verdict-driven controller
+    "dmlc_tpu/pipeline/graph.py",      # knob closures defined here
+    "dmlc_tpu/data/threaded_iter.py",  # set_capacity definition
+    "dmlc_tpu/parallel/sharded.py",    # initial prefetch_depth
+}
+# configure(coalesce=/parallel=/codec_level=) additionally allowed
+# where the option plane is DEFINED and where bench corpora set up
+# measurement variants (a bench config comparing codec on/off is an
+# experiment, not a hand-tuned steady-state constant)
+KNOB_CONFIGURE_ALLOWED = KNOB_MUTATION_ALLOWED | {
+    "dmlc_tpu/io/objstore/fs.py",      # configure() itself
+    "dmlc_tpu/bench_suite.py",         # measurement variants
+    "dmlc_tpu/bench_peer_worker.py",   # gang-bench wire setup
+}
+_KNOB_ATTRS = {"prefetch_depth", "window"}
+_KNOB_CONFIGURE_KWARGS = {"coalesce", "parallel", "codec_level"}
+
+
+def knob_lint(paths: List[str],
+              trees: Optional[dict] = None) -> List[str]:
+    """The knob gate: steady-state knob mutation (``.set_capacity()``
+    calls, ``.prefetch_depth``/``.window`` attribute assignment,
+    ``configure()`` with coalesce/parallel/codec_level) confined to
+    the exploration rails (see KNOB_MUTATION_ALLOWED)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        for node in ast.walk(tree):
+            if (rel not in KNOB_MUTATION_ALLOWED
+                    and isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "set_capacity"):
+                findings.append(
+                    f"{rel}:{node.lineno}: direct set_capacity() — "
+                    "queue depths are knobs; move them through the "
+                    "exploration rails (pipeline/autotune.py Autotuner "
+                    "or obs/control.py Controller) so the decision "
+                    "lands in the ledger")
+            if (rel not in KNOB_MUTATION_ALLOWED
+                    and isinstance(node, (ast.Assign, ast.AugAssign,
+                                          ast.AnnAssign))):
+                # every assignment form counts: plain, augmented
+                # (`w.window += 8`), annotated, and tuple-unpack
+                # targets. Only the ASSIGNED attribute itself matters
+                # — a knob attribute READ inside a target (a subscript
+                # index, an attribute-chain prefix) is not a mutation.
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                direct = []
+                stack = list(targets)
+                while stack:
+                    t = stack.pop()
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        stack.extend(t.elts)
+                    elif isinstance(t, ast.Starred):
+                        stack.append(t.value)
+                    elif isinstance(t, ast.Attribute):
+                        direct.append(t)
+                attrs = sorted({t.attr for t in direct
+                                if t.attr in _KNOB_ATTRS})
+                for attr in attrs:
+                    findings.append(
+                        f"{rel}:{node.lineno}: direct .{attr} "
+                        "assignment — a steady-state knob moves "
+                        "through the exploration rails "
+                        "(autotune/control), never a hand-set "
+                        "constant")
+            if (rel not in KNOB_CONFIGURE_ALLOWED
+                    and isinstance(node, ast.Call)
+                    and ((isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "configure")
+                         or (isinstance(node.func, ast.Name)
+                             and node.func.id == "configure"))):
+                hit = sorted(kw.arg for kw in node.keywords
+                             if kw.arg in _KNOB_CONFIGURE_KWARGS)
+                if hit:
+                    findings.append(
+                        f"{rel}:{node.lineno}: configure("
+                        f"{'/'.join(hit)}=...) outside the control "
+                        "plane — the wire knobs (coalesce, parallel, "
+                        "codec level) are moved by obs/control.py "
+                        "against the /analyze verdict; see "
+                        "docs/remote_io.md")
     return findings
 
 
@@ -753,6 +869,7 @@ def main() -> int:
     findings += io_seam_lint(paths, trees)
     findings += row_loop_lint(paths, trees)
     findings += verdict_lint(paths, trees)
+    findings += knob_lint(paths, trees)
     findings += codec_lint(paths, trees)
     findings += profile_lint(paths, trees)
     findings += http_client_lint(paths, trees)

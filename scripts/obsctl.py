@@ -20,6 +20,13 @@ Subcommands (all read-only; the plane stays in charge):
                  peer-served vs served-to-peers), so the objstore
                  peer tier's 1/N wire claim is visible on one
                  timeline;
+- ``control``  — a rank's ``/control`` decision ledger (the
+                 verdict-driven controller): knob state per family
+                 and every decision — trial / accepted / reverted /
+                 freeze / no-op — with the verdict evidence that
+                 caused it, so "why is this knob at this value" is
+                 answerable from the CLI; exit 2 with the server's
+                 enable hint when no controller is installed;
 - ``profile``  — a rank's ``/profile`` merged Python+native
                  flamegraph: live burst (``--seconds N --hz M``) or
                  the continuous trie, summarized as a top-frame
@@ -132,7 +139,11 @@ def render_stage_table(pl: Dict[str, Any]) -> str:
 
 def render_verdict(v: Dict[str, Any]) -> str:
     lines = [f"bound: {v.get('bound')}   band: {v.get('band')}   "
-             f"confidence: {v.get('confidence')}"]
+             f"confidence: {v.get('confidence')}"
+             # schema-3 verdicts are citable (the control ledger
+             # references them by id); older BENCH docs lack the field
+             + (f"   [{v['verdict_id']}]" if v.get("verdict_id")
+                else "")]
     sw = v.get("stage_waits") or {}
     lines.append(
         f"waits: parse {_fmt(sw.get('parse_s'), 3)}s  assemble "
@@ -290,6 +301,16 @@ def cmd_gang(args) -> int:
             print(f"    bytes: wire {_fmt(wire, 0)} · "
                   f"peer-served {_fmt(peer, 0)} · "
                   f"served-to-peers {_fmt(served, 0)}")
+        # the rank's control-plane cadence (collectors.control.* ride
+        # the same gang timeline): decisions made, climate freezes,
+        # reverted trials — the observe→act loop, visible per rank
+        dec = v.get("collectors.control.decisions")
+        if dec is not None:
+            print(f"    control: {_fmt(dec, 0)} decisions · "
+                  f"{_fmt(v.get('collectors.control.freezes'), 0)} "
+                  "freezes · "
+                  f"{_fmt(v.get('collectors.control.reverted'), 0)} "
+                  "reverted")
     roll = g["rollup"]["samples"]
     if roll:
         last = roll[-1]["v"]
@@ -301,6 +322,49 @@ def cmd_gang(args) -> int:
             gp = last.get("sum.counters.objstore.peer.bytes")
             print(f"  rollup bytes: wire {_fmt(gw, 0)} · "
                   f"peer-served {_fmt(gp, 0)} across reachable ranks")
+    return 0
+
+
+def render_control(doc: Dict[str, Any], last: int = 12) -> str:
+    """One /control payload -> knob state + the decision tail."""
+    lines = [f"controller: epoch {doc.get('epoch')}  "
+             + "  ".join(f"{k}={v}" for k, v in
+                         (doc.get("counts") or {}).items() if v)]
+    for name, k in sorted((doc.get("knobs") or {}).items()):
+        lines.append(
+            f"  knob {name} = {k['value']} (family {k['family']}, "
+            f"[{k['lo']},{k['hi']}], initial {k['initial']}"
+            + (", FROZEN" if k.get("frozen") else "") + ")")
+    led = doc.get("ledger") or {}
+    lines.append(f"ledger: {led.get('kept')} of {led.get('offered')} "
+                 f"decisions kept "
+                 f"({led.get('approx_bytes')}/{led.get('budget_bytes')} "
+                 f"bytes, {led.get('coarsenings')} coarsenings)")
+    for rec in (led.get("records") or [])[-last:]:
+        move = (f" {rec['knob']} {rec['old']}→{rec['new']}"
+                if rec.get("knob") else "")
+        lines.append(
+            f"  [e{rec.get('epoch')}] {rec.get('outcome', '?'):<10} "
+            f"{rec.get('family') or '-':<9} bound={rec.get('bound')}"
+            f"/{rec.get('band')}{move}  ({rec.get('verdict_id')})")
+        for e in (rec.get("evidence") or [])[:2]:
+            lines.append(f"      - {e}")
+    return "\n".join(lines)
+
+
+def cmd_control(args) -> int:
+    port = _default_port(args)
+    path = "/control" + (f"?last={args.last}" if args.last else "")
+    doc = _fetch(port, path, host=args.host)
+    if "ledger" not in doc:
+        # the server's 404 payload ({error, hint}: no controller
+        # installed) — surface the hint, exit 2 like history/gang
+        print(json.dumps(doc))
+        return 2
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    print(render_control(doc, last=args.keys))
     return 0
 
 
@@ -390,6 +454,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("gang", help="rank 0's merged gang view")
     common(p)
     p.set_defaults(fn=cmd_gang)
+
+    p = sub.add_parser("control",
+                       help="a rank's /control decision ledger "
+                            "(verdict-driven controller)")
+    common(p)
+    p.add_argument("--last", type=int, default=None,
+                   help="fetch only the trailing N ledger records")
+    p.add_argument("--keys", type=int, default=12,
+                   help="ledger records to render in the summary")
+    p.set_defaults(fn=cmd_control)
 
     p = sub.add_parser("profile",
                        help="a rank's merged Python+native flamegraph")

@@ -1,0 +1,756 @@
+"""dmlc_tpu.obs.control: the verdict-driven control plane.
+
+The observe→act loop, end to end: the ExplorationRail (accept /
+revert / cooldown / budget / regime gates shared with the autotuner),
+the bound→family policy (parse grows parse knobs, wire automates the
+remote-io advice, credit-limited FREEZES everything), the immutable
+byte-budgeted decision ledger, the /control endpoint + obsctl control
+rendering, flight-bundle attachment, pipeline adoption, chaos
+interplay under a deterministic-seed FaultPlan, and a REAL 2-process
+gang serving per-rank ledgers live."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dmlc_tpu.obs import control as obs_control
+from dmlc_tpu.obs.control import (
+    ControlKnob, Controller, DecisionLedger, RECORD_KEYS,
+)
+from dmlc_tpu.pipeline.autotune import ExplorationRail
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPTS = os.path.join(REPO, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+
+def _snap(stages, wall_s=2.0, epoch=1, bytes_=1 << 30):
+    """A pipeline stats snapshot whose sink carries ``bytes_`` (the
+    rail's throughput objective = sink bytes / wall)."""
+    stages = [dict(s) for s in stages]
+    stages[-1].setdefault("bytes", bytes_)
+    return {"schema": 1, "epoch": epoch, "wall_s": wall_s,
+            "stages": stages, "knobs": {}}
+
+
+def _parse_bound(epoch=1, wall_s=2.0, bytes_=1 << 30):
+    return _snap([
+        {"name": "parse", "kind": "parse", "wait_s": 0.9 * wall_s,
+         "bytes": bytes_},
+    ], wall_s=wall_s, epoch=epoch, bytes_=bytes_)
+
+
+def _store_knob(store, name="k", family="parse", lo=1, hi=64):
+    return ControlKnob(name, family,
+                       get=lambda: store[name],
+                       set=lambda n: store.__setitem__(name, n),
+                       lo=lo, hi=hi)
+
+
+class TestExplorationRail:
+    def test_accept_updates_reference(self):
+        rail = ExplorationRail()
+        store = {"v": 4}
+        rail.observe(100.0)  # reference epoch
+        rail.begin("k", 4, 8, lambda n: store.__setitem__("v", n))
+        out = rail.observe(150.0)
+        assert out["outcome"] == "accepted"
+        assert rail.best() == 150.0
+        assert store["v"] == 4  # accept never touches the knob
+
+    def test_revert_restores_freezes_and_charges_budget(self):
+        rail = ExplorationRail(cooldown=3, revert_budget=2)
+        store = {"v": 8}
+        rail.observe(100.0)
+        rail.begin("k", 4, 8, lambda n: store.__setitem__("v", n),
+                   group="parse")
+        out = rail.observe(50.0)  # < 0.9 * 100
+        assert out["outcome"] == "reverted"
+        assert store["v"] == 4          # restored
+        assert rail.frozen("k")         # cooldown gate
+        assert rail.reverts("parse") == 1
+        assert not rail.exhausted("parse")
+        rail.advance()
+        rail.begin("k2", 1, 2, lambda n: None, group="parse")
+        rail.observe(10.0)
+        assert rail.exhausted("parse")  # budget of 2 spent
+
+    def test_regime_change_discards_without_freeze_or_charge(self):
+        rail = ExplorationRail(revert_budget=1)
+        store = {"v": 8}
+        rail.note_regime((("cache", "parse"),))
+        rail.observe(100.0)
+        rail.begin("k", 4, 8, lambda n: store.__setitem__("v", n),
+                   group="parse")
+        trial = rail.note_regime((("cache", "pages"),))
+        assert trial["outcome"] == "discarded (replay tier changed)"
+        assert store["v"] == 4          # restored...
+        assert not rail.frozen("k")     # ...but no cooldown
+        assert rail.reverts("parse") == 0  # and no budget charge
+        assert rail.best() is None      # reference reset
+
+    def test_drop_source_restores_pending_and_releases_charges(self):
+        # a source dying mid-trial must not strand a process-global
+        # knob at its unjudged trial value, and its revert charges die
+        # with it — a ghost's reverts must not exhaust the family for
+        # every future pipeline in the process
+        rail = ExplorationRail(revert_budget=1, cooldown=0)
+        a, b = {"v": 8}, {"v": 2}
+        rail.observe(100.0, source="s")
+        rail.begin("a", 4, 8, lambda n: a.__setitem__("v", n),
+                   group="wire", source="s")
+        rail.observe(10.0, source="s")   # reverted: charge (wire, s)
+        assert rail.exhausted("wire", source="s")
+        rail.advance()
+        rail.begin("b", 1, 2, lambda n: b.__setitem__("v", n),
+                   group="wire", source="s")
+        rail.drop_source("s")
+        assert b["v"] == 1               # pending trial restored
+        assert rail.pending is None
+        assert rail.best("s") is None
+        assert not rail.exhausted("wire", source="s")
+        assert rail.reverts_total("wire") == 0
+
+    def test_cooldown_expires(self):
+        rail = ExplorationRail(cooldown=2)
+        rail.freeze("k")
+        assert rail.frozen("k")
+        rail.advance()
+        assert rail.frozen("k")
+        rail.advance()
+        assert not rail.frozen("k")
+
+
+class TestLedger:
+    def _rec(self, i):
+        return {"epoch": i, "verdict_id": f"v{i}-x", "bound": "parse",
+                "band": "unknown", "evidence": [f"parse wait {i}s"],
+                "family": "parse", "knob": "k", "old": 1, "new": 2,
+                "outcome": "trial", "reverted": False}
+
+    def test_coarsens_under_budget_keeping_ends(self):
+        led = DecisionLedger(budget_bytes=2 << 10)
+        for i in range(300):
+            led.append(self._rec(i))
+        d = led.to_dict()
+        assert d["offered"] == 300
+        assert d["kept"] < 300
+        assert d["coarsenings"] >= 1
+        assert d["approx_bytes"] <= d["budget_bytes"]
+        recs = d["records"]
+        assert recs[0]["epoch"] == 0      # the oldest survives
+        assert recs[-1]["epoch"] == 299   # the newest survives
+        assert [r["epoch"] for r in recs] == \
+            sorted(r["epoch"] for r in recs)
+
+    def test_last_trims(self):
+        led = DecisionLedger()
+        for i in range(10):
+            led.append(self._rec(i))
+        assert [r["epoch"] for r in led.records(last=3)] == [7, 8, 9]
+        assert len(led.to_dict(last=2)["records"]) == 2
+
+
+class TestControllerPolicy:
+    def _controller(self, knobs, **kw):
+        kw.setdefault("revert_budget", 2)
+        return Controller(knobs, **kw)
+
+    def test_parse_bound_grows_parse_family(self):
+        store = {"k": 4}
+        ctl = self._controller([_store_knob(store)])
+        try:
+            rec = ctl.observe(_parse_bound(epoch=1))
+            assert rec["outcome"] == "trial"
+            assert rec["family"] == "parse" and rec["knob"] == "k"
+            assert (rec["old"], rec["new"]) == (4, 8)
+            assert store["k"] == 8
+            # the record cites the exact verdict (epoch + digest)
+            assert rec["epoch"] == 1
+            assert rec["verdict_id"].startswith("v1-")
+            assert rec["bound"] == "parse" and rec["evidence"]
+            assert sorted(rec) == sorted(RECORD_KEYS)
+        finally:
+            ctl.close()
+
+    def test_xfer_bound_moves_transfer_family_only(self):
+        store = {"k": 4, "w": 2}
+        ctl = self._controller([
+            _store_knob(store, "k", "parse"),
+            ControlKnob("w", "transfer",
+                        get=lambda: store["w"],
+                        set=lambda n: store.__setitem__("w", n),
+                        lo=1, hi=32)])
+        try:
+            rec = ctl.observe(_snap([
+                {"name": "parse", "kind": "parse", "wait_s": 0.1},
+                {"name": "to_device", "kind": "to_device",
+                 "wait_s": 1.5, "extra": {"xfer_wait_s": 1.5}},
+            ]))
+            assert rec["bound"] == "xfer"
+            assert rec["family"] == "transfer" and rec["knob"] == "w"
+            assert store == {"k": 4, "w": 4}
+        finally:
+            ctl.close()
+
+    def test_wire_bound_automates_remote_io_advice(self):
+        # wire-bound + cold pagestore: the controller escalates the
+        # wire family in the documented order — coalesce first
+        opts = {"coalesce": 4, "parallel": 4, "codec": 0}
+        knobs = [
+            ControlKnob("wire.coalesce", "wire",
+                        lambda: opts["coalesce"],
+                        lambda n: opts.__setitem__("coalesce", n),
+                        lo=1, hi=16),
+            ControlKnob("wire.codec_level", "wire",
+                        lambda: opts["codec"],
+                        lambda n: opts.__setitem__("codec", n),
+                        lo=0, hi=9,
+                        grow=lambda cur: 6 if cur == 0 else cur),
+        ]
+        ctl = self._controller(knobs)
+        try:
+            metrics = {"counters": {
+                "pagestore.hit": 0, "pagestore.miss": 40,
+                "objstore.get": 40, "objstore.bytes": 1 << 30}}
+            rec = ctl.observe(_snap([
+                {"name": "parse", "kind": "parse", "wait_s": 1.0,
+                 "bytes": 1 << 30}]), metrics=metrics)
+            assert rec["bound"] == "wire"
+            assert rec["knob"] == "wire.coalesce"
+            assert opts["coalesce"] == 8 and opts["codec"] == 0
+            # coalesce trial regresses hard -> reverted + cooldown;
+            # the NEXT wire epoch escalates to the codec flip
+            rec = ctl.observe(_snap([
+                {"name": "parse", "kind": "parse", "wait_s": 1.0,
+                 "bytes": 1 << 24}]), metrics=metrics)
+            assert rec["outcome"] == "reverted"
+            assert opts["coalesce"] == 4
+            rec = ctl.observe(_snap([
+                {"name": "parse", "kind": "parse", "wait_s": 1.0,
+                 "bytes": 1 << 30}]), metrics=metrics)
+            assert rec["outcome"] == "trial"
+            assert rec["knob"] == "wire.codec_level"
+            assert (rec["old"], rec["new"]) == (0, 6)
+            assert opts["codec"] == 6
+        finally:
+            ctl.close()
+
+    def test_credit_limited_freezes_all_knobs_never_thrashes(self):
+        store = {"k": 4, "w": 2}
+        ctl = self._controller([
+            _store_knob(store, "k", "parse"),
+            _store_knob(store, "w", "transfer")])
+        try:
+            for epoch in range(1, 6):
+                rec = ctl.observe(_parse_bound(epoch=epoch),
+                                  epoch_gauges=[0.3, 0.4, 0.5])
+                assert rec["outcome"] == "freeze"
+                assert rec["band"] == "drained"
+                assert rec["knob"] is None and rec["new"] is None
+                assert any("drained" in e for e in rec["evidence"])
+            # the whole point: five drained epochs, zero knob motion
+            assert store == {"k": 4, "w": 2}
+            assert ctl.to_dict()["counts"]["freezes"] == 5
+        finally:
+            ctl.close()
+
+    def test_consumer_bound_is_an_explicit_noop(self):
+        store = {"k": 4}
+        ctl = self._controller([_store_knob(store)])
+        try:
+            rec = ctl.observe(_snap([
+                {"name": "parse", "kind": "parse", "wait_s": 0.01,
+                 "bytes": 1 << 30}]))
+            assert rec["bound"] == "consumer"
+            assert rec["outcome"] == "no-op"
+            assert store["k"] == 4
+        finally:
+            ctl.close()
+
+    def test_revert_budget_disables_family(self):
+        store = {"k": 4}
+        ctl = self._controller([_store_knob(store)], revert_budget=1,
+                               cooldown=0)
+        try:
+            ctl.observe(_parse_bound(epoch=1))            # trial 4->8
+            rec = ctl.observe(_parse_bound(epoch=2, bytes_=1 << 20))
+            assert rec["outcome"] == "reverted" and store["k"] == 4
+            rec = ctl.observe(_parse_bound(epoch=3))
+            assert rec["outcome"] == "family-exhausted"
+            assert store["k"] == 4  # the family stays put for good
+        finally:
+            ctl.close()
+
+    def test_credit_drain_discards_pending_trial_without_charge(self):
+        # a drained epoch judges NOTHING: the pending trial must be
+        # DISCARDED (restored, no freeze, no budget charge) — never
+        # reverted by the credit scheduler's throughput, which would
+        # burn the family's revert budget on climate noise
+        store = {"k": 4}
+        ctl = self._controller([_store_knob(store)], revert_budget=1)
+        try:
+            ctl.observe(_parse_bound(epoch=1))            # trial 4->8
+            assert store["k"] == 8
+            rec = ctl.observe(_parse_bound(epoch=2, bytes_=1 << 20),
+                              epoch_gauges=[0.3, 0.4])
+            assert store["k"] == 4                        # restored
+            outcomes = [r["outcome"] for r in ctl.ledger.records()]
+            assert outcomes == ["trial", "discarded", "freeze"]
+            assert rec["outcome"] == "freeze"
+            # no budget charge: the family can still explore after
+            assert not ctl.rail.exhausted("parse")
+            assert ctl.to_dict()["counts"]["reverted"] == 0
+        finally:
+            ctl.close()
+
+    def test_reverted_epoch_arms_no_new_trial(self):
+        # the double-count fix, on the controller's rails: the revert
+        # epoch's stats ran under the BAD value — its record IS the
+        # decision, and no second knob moves from it
+        store = {"k": 4, "k2": 2}
+        ctl = self._controller([
+            _store_knob(store, "k"), _store_knob(store, "k2")])
+        try:
+            ctl.observe(_parse_bound(epoch=1))
+            ctl.observe(_parse_bound(epoch=2, bytes_=1 << 20))
+            assert store == {"k": 4, "k2": 2}
+            outcomes = [r["outcome"] for r in ctl.ledger.records()]
+            assert outcomes == ["trial", "reverted"]
+        finally:
+            ctl.close()
+
+    def test_collector_rides_the_registry(self):
+        from dmlc_tpu.obs.metrics import REGISTRY
+        store = {"k": 4}
+        ctl = self._controller([_store_knob(store)])
+        try:
+            ctl.observe(_parse_bound())
+            snap = REGISTRY.snapshot()
+            col = snap["collectors"].get("control")
+            assert col is not None
+            assert col["decisions"] == 1 and col["trials"] == 1
+            assert col["knobs"]["k"] == 8
+        finally:
+            ctl.close()
+        assert "control" not in REGISTRY.snapshot()["collectors"]
+
+
+class TestAutotunerDoubleCountFix:
+    """Satellite pin: a reverted trial's epoch stats (measured under
+    the bad knob value) must not seed the NEXT trial — before the
+    rail extraction, the revert epoch immediately proposed the next
+    knob from its own polluted snapshot."""
+
+    def _snap(self, bytes_=10 ** 9):
+        stages = [
+            {"name": "prefetch", "kind": "prefetch", "items": 10,
+             "rows": 100, "nnz": 0, "bytes": bytes_, "wait_s": 0.5,
+             "wait_frac": 0.5, "throughput_gbps": None,
+             "rows_per_s": None, "queue_depth_mean": None,
+             "queue_cap": 4, "queue_occupancy": 0.9},
+            {"name": "to_device", "kind": "to_device", "items": 10,
+             "rows": 100, "nnz": 0, "bytes": bytes_, "wait_s": 0.1,
+             "wait_frac": 0.1, "throughput_gbps": None,
+             "rows_per_s": None, "queue_depth_mean": None,
+             "queue_cap": None, "queue_occupancy": None,
+             "extra": {"xfer_wait_s": 0.5}},
+        ]
+        return {"schema": 1, "epoch": 1, "wall_s": 1.0,
+                "stages": stages, "knobs": {}}
+
+    def test_no_proposal_from_reverted_epoch(self):
+        from dmlc_tpu.pipeline.autotune import Autotuner, Knob
+        store = {"a": 4, "b": 4}
+        knobs = [
+            Knob("prefetch.depth", "prefetch",
+                 lambda: store["a"],
+                 lambda n: store.__setitem__("a", n), lo=1, hi=64),
+            Knob("device.window", "to_device",
+                 lambda: store["b"],
+                 lambda n: store.__setitem__("b", n), lo=1, hi=32),
+        ]
+        t = Autotuner(knobs)
+        t.after_epoch(self._snap())                 # trial a: 4 -> 8
+        assert store["a"] == 8
+        t.after_epoch(self._snap(bytes_=10 ** 7))   # collapse: revert
+        assert store["a"] == 4
+        assert t.report()["decisions"][-1]["outcome"] == "reverted"
+        # the fix: knob b must NOT have been armed from the polluted
+        # epoch (before the fix it was proposed immediately)
+        assert store["b"] == 4
+        assert t.rail.pending is None
+        t.after_epoch(self._snap())                 # clean epoch:
+        assert store["b"] == 8                      # b proposes now
+
+
+class TestChaosInterplay:
+    """ISSUE satellite: under a deterministic-seed FaultPlan that
+    injects objstore faults while the credit climate is drained, the
+    controller must emit FREEZE decisions (never knob thrash) and the
+    ledger must carry the credit-band evidence."""
+
+    def test_freeze_under_chaos_and_drained_credits(self, tmp_path):
+        from dmlc_tpu.io import objstore
+        from dmlc_tpu.io.input_split import InputSplit
+        from dmlc_tpu.resilience import inject
+
+        payload = b"x" * (256 << 10)
+        em = objstore.configure(root=str(tmp_path / "objroot"))
+        plan = inject.install(
+            "site=io.objstore.get,fault=ioerror,times=2", seed=11)
+        store = {"k": 4, "wire.coalesce": 4}
+        ctl = Controller([
+            _store_knob(store, "k", "parse"),
+            _store_knob(store, "wire.coalesce", "wire")])
+        try:
+            em.put("bucket", "train/x.bin", payload)
+            for epoch in range(1, 4):
+                # a real remote read under the armed plan: the seam
+                # retries the injected faults, bytes stay identical
+                split = InputSplit.create("obj://bucket/train/x.bin",
+                                          0, 1)
+                got = b"".join(iter(split.next_chunk, None))
+                assert got == payload
+                rec = ctl.observe(
+                    _parse_bound(epoch=epoch),
+                    epoch_gauges=[0.4, 0.5, 0.3])  # drained climate
+                assert rec["outcome"] == "freeze", rec
+                assert rec["band"] == "drained"
+                assert any("drained" in e for e in rec["evidence"])
+            # chaos really ran (deterministic: times=2 exactly) and
+            # the controller never chased it with a knob move
+            assert plan.injected == 2
+            assert store == {"k": 4, "wire.coalesce": 4}
+            assert all(r["outcome"] == "freeze"
+                       for r in ctl.ledger.records())
+        finally:
+            ctl.close()
+            inject.uninstall()
+            objstore.configure(None)
+
+
+class TestPipelineAdoption:
+    def _corpus(self, tmp_path, rows=800):
+        import numpy as np
+        rng = np.random.RandomState(0)
+        lines = []
+        for i in range(rows):
+            nnz = rng.randint(3, 9)
+            idx = np.sort(rng.choice(500, nnz, replace=False))
+            feats = " ".join(f"{j}:{v:.4f}"
+                             for j, v in zip(idx, rng.rand(nnz)))
+            lines.append(f"{i % 2} {feats}")
+        p = tmp_path / "data.libsvm"
+        p.write_text("\n".join(lines) + "\n")
+        return str(p)
+
+    def test_installed_controller_subsumes_autotuner(self, tmp_path):
+        from dmlc_tpu.pipeline import Pipeline
+        uri = self._corpus(tmp_path)
+        built = (Pipeline.from_uri(uri).parse(format="libsvm")
+                 .batch(64).prefetch(depth="auto")
+                 .build(autotune=True))
+        ctl = obs_control.install(Controller())
+        try:
+            for _ in range(3):
+                built.run_epoch()
+            # the pipeline's "auto" knobs joined the families...
+            knobs = ctl.to_dict()["knobs"]
+            assert "prefetch.depth" in knobs
+            assert knobs["prefetch.depth"]["family"] == "assemble"
+            # ...one decision per epoch landed in the ledger...
+            assert len(ctl.ledger.records()) >= 3
+            assert ctl.to_dict()["epoch"] == 3
+            # ...and the blind hill-climber stood down (one mover)
+            assert built.autotune_report()["decisions"] == []
+        finally:
+            obs_control.uninstall()
+            built.close()
+        assert obs_control.active() is None
+
+    def test_adopted_knobs_move_only_for_their_pipeline(self, tmp_path):
+        # pipeline B's verdict must never trial pipeline A's knob: A's
+        # knob cannot affect B's throughput, so the rail would judge
+        # the move by rates it cannot change (accepts forever). Name
+        # collisions across live pipelines get the stable source-token
+        # prefix, never apostrophe mangling.
+        from dmlc_tpu.pipeline import Pipeline
+        uri = self._corpus(tmp_path, rows=400)
+
+        def build():
+            return (Pipeline.from_uri(uri).parse(format="libsvm")
+                    .batch(64).prefetch(depth="auto").build())
+
+        a, b = build(), build()
+        ctl = Controller()
+        try:
+            tok_a = ctl.adopt_pipeline(a)
+            tok_b = ctl.adopt_pipeline(b)
+            knobs = ctl.to_dict()["knobs"]
+            assert "prefetch.depth" in knobs            # A's, bare
+            assert f"{tok_b}.prefetch.depth" in knobs   # B's, stable
+            assert not any("'" in k for k in knobs)
+            # an assemble-bound epoch observed FOR B moves B's knob
+            asm = _snap([{"name": "batch", "kind": "assemble",
+                          "wait_s": 1.0, "bytes": 1 << 30,
+                          "extra": {"assemble_s": 0.9}}])
+            rec = ctl.observe(asm, source=tok_b)
+            assert rec["outcome"] == "trial"
+            assert rec["knob"] == f"{tok_b}.prefetch.depth"
+            vals = ctl.knob_values()
+            assert vals["prefetch.depth"] == 4          # A untouched
+            assert vals[f"{tok_b}.prefetch.depth"] == 8
+        finally:
+            ctl.close()
+            a.close()
+            b.close()
+
+    def test_closed_pipeline_knobs_retire(self, tmp_path):
+        # a rebuilt pipeline must not leave the controller trialing a
+        # DEAD pipeline's knobs (or growing name' name'' aliases): the
+        # adopted knobs ride the pipeline's lifetime and retire with
+        # it, cancelling any pending trial without a budget charge
+        import gc
+        from dmlc_tpu.pipeline import Pipeline
+        uri = self._corpus(tmp_path, rows=400)
+
+        def build():
+            return (Pipeline.from_uri(uri).parse(format="libsvm")
+                    .batch(64).prefetch(depth="auto")
+                    .build(autotune=True))
+
+        ctl = obs_control.install(Controller())
+        try:
+            built = build()
+            built.run_epoch()
+            assert "prefetch.depth" in ctl.to_dict()["knobs"]
+            built.close()
+            del built
+            gc.collect()
+            built = build()
+            built.run_epoch()
+            knobs = ctl.to_dict()["knobs"]
+            assert "prefetch.depth" in knobs
+            assert "prefetch.depth'" not in knobs  # no alias growth
+            assert len([k for k in knobs
+                        if k.startswith("prefetch.depth")]) == 1
+            built.close()
+        finally:
+            obs_control.uninstall()
+
+    def test_delta_metrics_scoped_per_source(self):
+        # two interleaved sources: each epoch's wire counters are
+        # delta-scoped against that SOURCE's previous epoch, never the
+        # other one's (A must not absorb B's traffic)
+        from dmlc_tpu.obs.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        ctl = Controller([], registry=reg)
+        try:
+            reg.counter("objstore.bytes").inc(100)
+            ctl._delta_metrics("A")   # A's baseline: 100
+            reg.counter("objstore.bytes").inc(50)
+            ctl._delta_metrics("B")   # B's baseline: 150
+            reg.counter("objstore.bytes").inc(7)
+            dA = ctl._delta_metrics("A")
+            assert dA["counters"]["objstore.bytes"] == 57
+            dB = ctl._delta_metrics("B")
+            assert dB["counters"]["objstore.bytes"] == 7
+        finally:
+            ctl.close()
+
+    def test_detach_suspends_without_closing(self):
+        from dmlc_tpu.obs.metrics import REGISTRY
+        ctl = obs_control.install(Controller())
+        try:
+            assert "control" in REGISTRY.snapshot()["collectors"]
+            suspended = obs_control.detach()
+            assert suspended is ctl
+            assert obs_control.active() is None
+            # the "control" collector name is FREE while suspended —
+            # a probe's own controller owns the gang/metrics surface
+            assert "control" not in REGISTRY.snapshot()["collectors"]
+            probe = Controller([])
+            assert "control" in REGISTRY.snapshot()["collectors"]
+            probe.close()
+            # reinstall resumes the collector, ledger intact
+            assert obs_control.install(suspended) is ctl
+            assert obs_control.active() is ctl
+            assert "control" in REGISTRY.snapshot()["collectors"]
+        finally:
+            obs_control.uninstall()
+
+    def test_install_if_env(self, monkeypatch):
+        monkeypatch.delenv(obs_control.ENV_CONTROL, raising=False)
+        assert obs_control.install_if_env() is None
+        monkeypatch.setenv(obs_control.ENV_CONTROL, "0")
+        assert obs_control.install_if_env() is None
+        monkeypatch.setenv(obs_control.ENV_CONTROL, "1")
+        try:
+            ctl = obs_control.install_if_env()
+            assert ctl is not None
+            # the default controller owns the wire family (the
+            # automated docs/remote_io.md advice)
+            fams = {k["family"] for k in ctl.to_dict()["knobs"].values()}
+            assert fams == {"wire"}
+        finally:
+            obs_control.uninstall()
+
+
+class TestServeAndCli:
+    def _get(self, url, timeout_s=5.0):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_control_endpoint_and_obsctl(self, capsys):
+        from dmlc_tpu.obs.serve import StatusServer
+        import obsctl
+        store = {"k": 4}
+        srv = StatusServer(port=0)
+        try:
+            # no controller yet: 404 with the enable hint
+            status, body = self._get(srv.url("/control"))
+            assert status == 404
+            assert b"DMLC_TPU_CONTROL" in body
+            assert obsctl.main(["control", "--port",
+                                str(srv.port)]) == 2
+            capsys.readouterr()
+            ctl = obs_control.install(
+                Controller([_store_knob(store)]))
+            ctl.observe(_parse_bound(epoch=1))
+            ctl.observe(_parse_bound(epoch=2, bytes_=2 << 30))
+            status, body = self._get(srv.url("/control"))
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["schema"] == obs_control.CONTROL_SCHEMA
+            recs = doc["ledger"]["records"]
+            assert [r["outcome"] for r in recs] == \
+                ["trial", "accepted", "trial"]
+            assert doc["knobs"]["k"]["value"] == 16
+            # ?last=N trims the ledger, state stays whole
+            doc = json.loads(self._get(
+                srv.url("/control?last=1"))[1])
+            assert len(doc["ledger"]["records"]) == 1
+            # the operator CLI renders decision + evidence, exit 0
+            assert obsctl.main(["control", "--port",
+                                str(srv.port)]) == 0
+            out = capsys.readouterr().out
+            assert "trial" in out and "accepted" in out
+            assert "parse wait" in out      # the evidence line
+            assert "knob k = 16" in out
+        finally:
+            obs_control.uninstall()
+            srv.close()
+
+    def test_flight_bundle_attaches_control_json(self, tmp_path):
+        from dmlc_tpu.obs import flight as obs_flight
+        store = {"k": 4}
+        ctl = obs_control.install(Controller([_store_knob(store)]))
+        fl = obs_flight.FlightRecorder(
+            out_dir=str(tmp_path / "flight")).install()
+        try:
+            ctl.observe(_parse_bound())
+            d = fl.dump("unit_test")
+            doc = json.load(open(os.path.join(d, "control.json")))
+            assert doc["schema"] == obs_control.CONTROL_SCHEMA
+            assert doc["ledger"]["records"][0]["outcome"] == "trial"
+            manifest = json.load(
+                open(os.path.join(d, "MANIFEST.json")))
+            assert manifest["files"]["control.json"] == "ok"
+        finally:
+            fl.uninstall()
+            obs_control.uninstall()
+
+
+class TestGangControlLive:
+    """Acceptance: a REAL 2-process launch_local(control=True) gang —
+    every rank runs the controller over its own pipeline and serves
+    its decision ledger at /control WHILE the gang runs."""
+
+    def test_two_process_gang_serves_control(self, tmp_path):
+        from dmlc_tpu.parallel.launch import find_free_ports, launch_local
+        corpus = TestPipelineAdoption()._corpus(tmp_path, rows=1200)
+        script = tmp_path / "control_worker.py"
+        stop_file = tmp_path / "stop"
+        script.write_text(
+            "import os, sys, time\n"
+            "from dmlc_tpu.obs.serve import serve_if_env\n"
+            "from dmlc_tpu.obs.control import install_if_env\n"
+            "from dmlc_tpu.pipeline import Pipeline\n"
+            "srv = serve_if_env()\n"
+            "assert srv is not None, 'serve port env missing'\n"
+            "ctl = install_if_env()\n"
+            "assert ctl is not None, 'control env missing'\n"
+            "built = (Pipeline.from_uri(sys.argv[1])\n"
+            "         .parse(format='libsvm')\n"
+            "         .batch(64).prefetch(depth='auto')\n"
+            "         .build(autotune=True))\n"
+            "for _ in range(4):\n"
+            "    built.run_epoch()\n"
+            "built.close()\n"
+            "deadline = time.time() + 30\n"
+            "while not os.path.exists(sys.argv[2]) "
+            "and time.time() < deadline:\n"
+            "    time.sleep(0.05)\n"
+        )
+        ports = find_free_ports(2)
+        env = {"PYTHONPATH": os.pathsep.join(
+            [REPO] + os.environ.get("PYTHONPATH", "").split(os.pathsep))}
+        result = {}
+
+        def gang():
+            try:
+                result["codes"] = launch_local(
+                    2, [sys.executable, str(script), corpus,
+                        str(stop_file)],
+                    env=env, serve_ports=ports, control=True,
+                    timeout=120)
+            except Exception as e:  # noqa: BLE001
+                result["error"] = e
+
+        t = threading.Thread(target=gang, daemon=True)
+        t.start()
+        try:
+            # poll until BOTH ranks serve a non-empty decision ledger
+            # — the controller is running and citable DURING the run
+            deadline = time.time() + 60.0
+            ledgers = {}
+            while len(ledgers) < 2 and time.time() < deadline:
+                for rank, port in enumerate(ports):
+                    if rank in ledgers:
+                        continue
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{port}/control",
+                                timeout=2.0) as r:
+                            doc = json.load(r)
+                    except (OSError, ValueError,
+                            urllib.error.URLError):
+                        time.sleep(0.05)
+                        continue
+                    if doc.get("ledger", {}).get("records"):
+                        ledgers[rank] = doc
+                time.sleep(0.05)
+            assert len(ledgers) == 2, f"gang never served: {result}"
+            for rank, doc in ledgers.items():
+                recs = doc["ledger"]["records"]
+                assert all(sorted(r) == sorted(RECORD_KEYS)
+                           for r in recs), recs
+                assert all(r["verdict_id"] for r in recs)
+                # the adopted pipeline knob is visible per rank
+                assert "prefetch.depth" in doc["knobs"]
+        finally:
+            stop_file.write_text("stop")
+            t.join(timeout=60.0)
+        assert result.get("codes") == [0, 0], result

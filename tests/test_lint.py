@@ -250,6 +250,58 @@ class TestLintGate:
         flight = os.path.join(lint.REPO, "dmlc_tpu", "obs", "flight.py")
         assert lint.io_seam_lint([flight]) == []
 
+    def test_knob_gate_clean(self):
+        # steady-state knob mutation (set_capacity, depth/window
+        # assignment, configure(coalesce/parallel/codec_level))
+        # confined to the exploration rails + the pinned allowlist
+        findings = lint.knob_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_knob_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe10.py")
+        with open(bad, "w") as f:
+            f.write("def tune(ti, it, dev, objstore, a, b):\n"
+                    "    ti.set_capacity(8)\n"
+                    "    it.prefetch_depth = 4\n"
+                    "    dev.window = 16\n"
+                    "    dev.window += 8\n"          # augmented form
+                    "    dev.window: int = 4\n"      # annotated form
+                    "    a.prefetch_depth, b = 2, 0\n"  # tuple unpack
+                    "    objstore.configure(coalesce=8, parallel=2)\n"
+                    "    objstore.configure(hydrate=False)\n"  # fine
+                    "    b[dev.window] = 1\n"        # READ: fine
+                    "    dev.window.inner = 2\n")    # assigns .inner
+        try:
+            findings = lint.knob_lint([bad])
+        finally:
+            os.remove(bad)
+        kinds = "\n".join(findings)
+        assert len(findings) == 7, kinds
+        assert "direct set_capacity()" in kinds
+        assert kinds.count(".prefetch_depth assignment") == 2
+        assert kinds.count(".window assignment") == 3
+        assert "configure(coalesce/parallel=...)" in kinds
+
+    def test_knob_gate_exempts_the_rails(self):
+        for rel in ("pipeline/autotune.py", "obs/control.py",
+                    "pipeline/graph.py"):
+            path = os.path.join(lint.REPO, "dmlc_tpu",
+                                *rel.split("/"))
+            assert lint.knob_lint([path]) == [], rel
+
+    def test_verdict_gate_exempts_decision_records(self):
+        # a control-plane ledger record carries bound+evidence but
+        # CITES a verdict (by id) rather than being one — "outcome"
+        # marks it; its shape is pinned by obs/control.py RECORD_KEYS
+        probe = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe11.py")
+        with open(probe, "w") as f:
+            f.write("R = {'bound': 'parse', 'evidence': [],\n"
+                    "     'outcome': 'trial'}\n")
+        try:
+            assert lint.verdict_lint([probe]) == []
+        finally:
+            os.remove(probe)
+
     def test_codec_gate_clean(self):
         # no direct zlib/gzip/bz2/lzma imports in dmlc_tpu/ outside
         # io/codec.py and the pinned crc32 allowlist
