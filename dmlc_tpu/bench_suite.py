@@ -52,6 +52,15 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                against the verdict, every decision lands schema-valid
                in the ledger, and reverts stay within the revert
                budget (throughput never silently regresses past it)
+ 17. parquet_native — ABI-8 native Parquet PAGE decode vs the pyarrow
+               golden on a decode-bound corpus (null-bearing f32
+               columns, UNCOMPRESSED V1 pages), sha256 stream parity
+               at 1/2/4 shards, interleaved + gauge-tagged; asserts
+               native >= 3x the golden and outstanding() == 0
+ 18. image_record — ABI-8 image-payload decode: the config-3
+               MXNet-style .rec scenario's DECODED batches (raw
+               uniform HWC u8 -> padded device-layout f32), python /
+               native / sharded x2 sha256-identical
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 
@@ -229,6 +238,63 @@ def make_parquet(path: str, mb: int, seed: int = 0) -> int:
     for c in range(28):
         cols[f"f{c}"] = pa.array(rng.rand(nrows).astype(np.float32))
     pq.write_table(pa.table(cols), path, row_group_size=max(1, nrows // 16))
+    return os.path.getsize(path)
+
+
+def make_parquet_decode_bound(path: str, mb: int, seed: int = 0) -> int:
+    """Config-17 corpus — the BASELINE config-5 DECODE-bound shape:
+    null-bearing float32 feature columns (real tabular data carries
+    nulls, and nulls knock the pyarrow golden off its zero-copy fast
+    path onto per-column to_numpy + np.stack) in moderate row groups,
+    UNCOMPRESSED V1 PLAIN pages so the measured wall is pure DECODE on
+    both contenders, never zlib (gzip makes both engines the same
+    zlib inflate)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    if os.path.exists(path) and os.path.getsize(path) >= (mb << 20) // 2:
+        return os.path.getsize(path)
+    rng = np.random.RandomState(seed)
+    ncol = 20
+    nrows = (mb << 20) // (ncol * 4 + 8)
+    cols = {"label": pa.array(rng.rand(nrows).astype(np.float32))}
+    for c in range(ncol):
+        vals = rng.rand(nrows).astype(np.float64)
+        mask = rng.rand(nrows) < 0.10
+        arr = pa.array(vals, type=pa.float32(),
+                       mask=mask)  # 10% nulls, f32 storage
+        cols[f"f{c}"] = arr
+    pq.write_table(pa.table(cols), path, row_group_size=4000,
+                   compression="NONE", use_dictionary=False)
+    return os.path.getsize(path)
+
+
+def make_image_recordio(path: str, mb: int, seed: int = 0,
+                        shape=(32, 32, 3)) -> int:
+    """Config-18 corpus — the MXNet-style ImageNet ``.rec`` scenario
+    (BASELINE config 3) with DECODABLE payloads: uniform-shape raw HWC
+    u8 images (frozen ABI-8 payload contract), a sprinkle of pixel
+    runs spelling the frame magic so the escaped multi-frame decode
+    path runs inside the measured epoch."""
+    import struct
+
+    from dmlc_tpu.io.recordio import RECORDIO_MAGIC, ImageRecordWriter
+    from dmlc_tpu.io.stream import create_stream
+    if os.path.exists(path) and os.path.getsize(path) >= (mb << 20) * 3 // 4:
+        return os.path.getsize(path)
+    rng = np.random.RandomState(seed)
+    magic = np.frombuffer(struct.pack("<I", RECORDIO_MAGIC), np.uint8)
+    per_rec = 16 + int(np.prod(shape))
+    with create_stream(path, "w") as s:
+        w = ImageRecordWriter(s)
+        written = 0
+        i = 0
+        while written < (mb << 20):
+            px = rng.randint(0, 256, shape).astype(np.uint8)
+            if i % 101 == 0:
+                px.reshape(-1)[8:12] = magic  # 4-aligned in the payload
+            w.write(float(i % 1000), px)
+            written += per_rec + 8
+            i += 1
     return os.path.getsize(path)
 
 
@@ -1466,6 +1532,214 @@ def bench_control(mb: int) -> Dict:
             "ledger": records[-8:]}
 
 
+def bench_parquet_native(mb: int, gauge_fn=None) -> Dict:
+    """Config 17 (the ABI-8 PR): native Parquet PAGE decode vs the
+    pyarrow golden — the last DECODE-bound wall of the format matrix
+    (ROADMAP item 4, BASELINE config 5). A decode-bound corpus
+    (null-bearing f32 columns, UNCOMPRESSED V1 PLAIN pages — see
+    make_parquet_decode_bound) runs through format="parquet_native"
+    four ways — engine=python (the pyarrow golden), engine=native (the
+    row-group page decoder), and native with shards=2 and shards=4
+    (row-group-aligned byte ranges) — with every contender's epochs
+    INTERLEAVED so the speedup is judged in ONE credit climate
+    (gauge-tagged, the config-12/14 discipline). Asserts the
+    acceptance: all four streams sha256-identical, ``outstanding()``
+    == 0 between native epochs, and native >= 3x the golden."""
+    import hashlib
+
+    from dmlc_tpu.data.parser import Parser
+
+    if gauge_fn is None:
+        from dmlc_tpu.bench_transfer import memcpy_gauge
+        gauge_fn = memcpy_gauge
+    path = f"{_TMP}.decode.parquet"
+    size = make_parquet_decode_bound(path, mb, seed=17)
+
+    def build(engine, shards=None):
+        kw = {"shards": shards} if shards else {}
+        return Parser.create(path, 0, 1, format="parquet_native",
+                             engine=engine, label_column="label", **kw)
+
+    def measure(parser, state):
+        state.setdefault("gauges", []).append(round(gauge_fn(), 2))
+        t0 = time.perf_counter()
+        parser.before_first()
+        rows = 0
+        while parser.next():
+            rows += parser.value().size
+        state.setdefault("walls", []).append(time.perf_counter() - t0)
+        state["rows"] = rows
+        if hasattr(parser, "outstanding"):
+            state["outstanding"] = int(parser.outstanding())
+
+    def stream_hash(parser):
+        h = hashlib.sha256()
+        parser.before_first()
+        while parser.next():
+            b = parser.value()
+            h.update(np.diff(np.asarray(b.offset))
+                     .astype("<i8").tobytes())
+            h.update(np.ascontiguousarray(b.label).tobytes())
+            h.update(np.ascontiguousarray(b.index)
+                     .astype("<u4").tobytes())
+            h.update(np.ascontiguousarray(b.value).tobytes())
+        return h.hexdigest()
+
+    def finish(parser, state):
+        out = {"gbps": round(size / min(state["walls"]) / 1e9, 4),
+               "epoch_walls": [round(w, 3) for w in state["walls"]],
+               "epoch_gauges": state["gauges"],
+               "rows": state["rows"],
+               "outstanding_after_epoch": state.get("outstanding"),
+               "hash": stream_hash(parser)}
+        if hasattr(parser, "destroy"):
+            parser.destroy()
+        return out
+
+    from dmlc_tpu import native
+    have_native = native.native_available()
+    contenders = {"python": build("python")}
+    if have_native:
+        contenders.update({"native": build("native"),
+                           "sharded2": build("native", shards=2),
+                           "sharded4": build("native", shards=4)})
+    states: Dict[str, Dict] = {k: {} for k in contenders}
+    for _ in range(3):  # interleaved: one credit climate for all
+        for k, p in contenders.items():
+            measure(p, states[k])
+    results = {k: finish(p, states[k]) for k, p in contenders.items()}
+    py = results["python"]
+    out = {"config": "parquet_native", "bytes": size,
+           "decode_path_golden": "pyarrow",
+           "python": py, "gbps": py["gbps"], "hash": py["hash"],
+           "epoch_gauges": py["epoch_gauges"]}
+    if have_native:
+        nat = results["native"]
+        for name in ("native", "sharded2", "sharded4"):
+            r = results[name]
+            assert r["hash"] == py["hash"], \
+                (f"{name} parquet stream diverged from the pyarrow "
+                 "golden")
+            assert r["outstanding_after_epoch"] == 0, \
+                f"{name}: {r['outstanding_after_epoch']} leases leaked"
+        speedup = nat["gbps"] / py["gbps"]
+        assert speedup >= 3.0, \
+            (f"native page decode {nat['gbps']} GB/s is only "
+             f"{speedup:.2f}x the pyarrow golden {py['gbps']} GB/s "
+             "(acceptance: >= 3x on the decode-bound corpus)")
+        out.update({
+            "native": nat, "sharded2": results["sharded2"],
+            "sharded4": results["sharded4"], "gbps": nat["gbps"],
+            "epoch_gauges": nat["epoch_gauges"],
+            "speedup_native_vs_pyarrow": round(speedup, 3),
+            "speedup_sharded2_vs_native": round(
+                results["sharded2"]["gbps"] / nat["gbps"], 3),
+            "speedup_sharded4_vs_native": round(
+                results["sharded4"]["gbps"] / nat["gbps"], 3)})
+    else:
+        out.update({"native": None, "sharded2": None, "sharded4": None,
+                    "speedup_native_vs_pyarrow": None})
+    return out
+
+
+def bench_image_record(mb: int, gauge_fn=None) -> Dict:
+    """Config 18 (the ABI-8 PR): the config-3 ImageNet-``.rec``
+    scenario finally produces DECODED batches — a uniform-shape raw
+    HWC u8 corpus (escaped-magic records included) runs through
+    ``parse(format="recordio_image") → batch(pad=True)`` as python
+    golden / native / native shards=2, padded batches hashed in an
+    untimed parity pass (all streams sha256-identical — the
+    decoded-batch parity acceptance), native epochs interleaved and
+    gauge-tagged, ``outstanding()`` == 0 between epochs."""
+    import hashlib
+
+    from dmlc_tpu.pipeline import Pipeline
+
+    if gauge_fn is None:
+        from dmlc_tpu.bench_transfer import memcpy_gauge
+        gauge_fn = memcpy_gauge
+    path = f"{_TMP}.images.rec"
+    shape = (32, 32, 3)
+    size = make_image_recordio(path, mb, seed=18, shape=shape)
+    rows = 256
+    nnz_bucket = rows * int(np.prod(shape))
+
+    def build(engine, shards=None):
+        kw = {"shards": shards} if shards else {}
+        return (Pipeline.from_uri(path)
+                .parse(format="recordio_image", engine=engine, **kw)
+                .batch(rows, pad=True, nnz_bucket=nnz_bucket)
+                .build())
+
+    def measure(built, state):
+        state.setdefault("gauges", []).append(round(gauge_fn(), 2))
+        t0 = time.perf_counter()
+        for _ in built:
+            pass
+        state.setdefault("walls", []).append(time.perf_counter() - t0)
+        parser = getattr(built._runners[0], "_parser", None)
+        if parser is not None and hasattr(parser, "outstanding"):
+            state["outstanding"] = int(parser.outstanding())
+
+    def finish(built, state):
+        snap = built.stats()
+        apath = next((x["assembly_path"] for s in snap["stages"]
+                      if (x := s.get("extra") or {}).get("assembly_path")),
+                     None)
+        h = hashlib.sha256()
+        n = 0
+        for b in built:
+            for k in sorted(b):
+                h.update(k.encode())
+                h.update(np.ascontiguousarray(b[k]).tobytes())
+            n += 1
+        built.close()
+        return {"gbps": round(size / min(state["walls"]) / 1e9, 4),
+                "epoch_walls": [round(w, 3) for w in state["walls"]],
+                "epoch_gauges": state["gauges"],
+                "assembly_path": apath, "batches": n,
+                "outstanding_after_epoch": state.get("outstanding"),
+                "hash": h.hexdigest()}
+
+    from dmlc_tpu import native
+    py_built, py_state = build("python"), {}
+    measure(py_built, py_state)
+    py = finish(py_built, py_state)
+    out = {"config": "image_record", "bytes": size,
+           "shape": list(shape), "rows": rows, "python": py,
+           "gbps": py["gbps"], "hash": py["hash"],
+           "epoch_gauges": py["epoch_gauges"]}
+    if native.native_available():
+        contenders = {"native": build("native"),
+                      "sharded": build("native", shards=2)}
+        states = {k: {} for k in contenders}
+        for _ in range(3):
+            for k, b in contenders.items():
+                measure(b, states[k])
+        nat = finish(contenders["native"], states["native"])
+        sh = finish(contenders["sharded"], states["sharded"])
+        assert nat["assembly_path"] == "native-padded", \
+            f"native image decode fell back to {nat['assembly_path']}"
+        for name, r in (("native", nat), ("sharded", sh)):
+            assert r["hash"] == py["hash"], \
+                (f"{name} decoded-batch stream diverged from the "
+                 "python golden")
+            assert r["outstanding_after_epoch"] == 0, \
+                f"{name}: {r['outstanding_after_epoch']} leases leaked"
+        out.update({
+            "native": nat, "sharded": sh, "gbps": nat["gbps"],
+            "epoch_gauges": nat["epoch_gauges"],
+            "speedup_native_vs_python": round(
+                nat["gbps"] / py["gbps"], 3),
+            "speedup_sharded_vs_native": round(
+                sh["gbps"] / nat["gbps"], 3)})
+    else:
+        out.update({"native": None, "sharded": None,
+                    "speedup_native_vs_python": None,
+                    "speedup_sharded_vs_native": None})
+    return out
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -1483,13 +1757,15 @@ CONFIGS = {
     14: ("recio_native", lambda mb, dev: bench_recio_native(mb)),
     15: ("peer_hydrate", lambda mb, dev: bench_peer_hydrate(mb)),
     16: ("control", lambda mb, dev: bench_control(mb)),
+    17: ("parquet_native", lambda mb, dev: bench_parquet_native(mb)),
+    18: ("image_record", lambda mb, dev: bench_image_record(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-16 (0 = all)")
+                    help="1-18 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -1553,9 +1829,11 @@ def main(argv: Optional[List[str]] = None) -> None:
             # warm pass would double the slowest part of the suite)
             # ... and config 15's gang manages its own cold/warm split;
             # config 16's controller probe runs its own epoch sequence
-            # (a warm pass would pre-move the knobs it asserts on)
+            # (a warm pass would pre-move the knobs it asserts on);
+            # configs 17/18 interleave 3 epochs per contender
+            # (self-warming, pyarrow-golden legs are the slow part)
             if not args.cold and n not in (7, 8, 9, 10, 11, 13, 14,
-                                           15, 16):
+                                           15, 16, 17, 18):
                 fn(args.mb, args.device)  # warm imports + page cache
             trace_path = None
             if args.trace:

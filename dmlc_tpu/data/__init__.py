@@ -13,7 +13,8 @@ import dmlc_tpu.data.libsvm_parser  # noqa: F401  (registers "libsvm")
 import dmlc_tpu.data.csv_parser     # noqa: F401  (registers "csv")
 import dmlc_tpu.data.libfm_parser   # noqa: F401  (registers "libfm")
 import dmlc_tpu.data.dense_record_parser  # noqa: F401 (registers "recordio_dense")
-import dmlc_tpu.data.parquet_parser  # noqa: F401 (registers "parquet" if pyarrow)
+import dmlc_tpu.data.image_record_parser  # noqa: F401 (registers "recordio_image")
+import dmlc_tpu.data.parquet_parser  # noqa: F401 (registers "parquet" + "parquet_native")
 
 __all__ = ["RowBlock", "Row", "RowBlockContainer", "Parser", "DataIter",
            "RowBlockIter"]

@@ -7,8 +7,16 @@ scheme is registered unconditionally and raises an informative error when
 pyarrow is missing (this environment may not ship it — gated, not faked).
 
 Row-group granularity maps to InputSplit semantics: row groups are
-distributed across (part_index, num_parts) by round-robin, which preserves
-the coverage/no-overlap invariant at row-group granularity.
+distributed across (part_index, num_parts) as CONTIGUOUS row-group
+ranges by the standard InputSplit byte rule applied at group
+granularity — nstep = ceil(total_bytes/num_parts), and a group belongs
+to part j iff its global byte start lands in [j*nstep, (j+1)*nstep).
+This preserves the coverage/no-overlap invariant AND makes the parts
+concatenate in file order, so the native engine's row-group-aligned
+sharded parse (``shards=N``, ABI 8) is byte-identical to the 1-parser
+stream — the SAME rule, pinned by tests/test_parquet_native.py.
+(r14 semantic change: pre-ABI-8 this was a round-robin distribution;
+sorted per-part coverage is unchanged, per-part ORDER is not.)
 """
 
 from __future__ import annotations
@@ -41,6 +49,11 @@ class ParquetParserParam(Parameter):
 
 
 class ParquetParser(Parser):
+    # which decode path this parser IS — the obs/analyze decode
+    # evidence (stage extra "decode_path") names it so a config-5-
+    # shaped DECODE-bound epoch says pyarrow-golden vs native-page
+    decode_path = "pyarrow"
+
     def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1,
                  index_dtype=np.uint32, prefetch: bool = True,
                  **kwargs: Any):
@@ -66,10 +79,8 @@ class ParquetParser(Parser):
         # (VERDICT r4 #7).
         self._sources = [self._open_source(p, size) for p, size in entries]
         self._files = [_pq.ParquetFile(s) for s in self._sources]
-        # (file_idx, row_group_idx) pairs round-robined across parts
-        groups = [(fi, gi) for fi, f in enumerate(self._files)
-                  for gi in range(f.num_row_groups)]
-        self._groups = groups[part_index::num_parts]
+        self._groups = self._partition_groups(self._files, entries,
+                                              part_index, num_parts)
         self._pos = 0
         self._block: Optional[RowBlock] = None
         # bytes_read reports COMPRESSED on-disk bytes (what GB/s is
@@ -80,6 +91,41 @@ class ParquetParser(Parser):
         # before_first() first, which would discard (and re-read) any
         # eagerly prefetched row groups
         self._want_prefetch = prefetch and len(self._groups) > 1
+
+    @staticmethod
+    def _partition_groups(files, entries, part_index: int,
+                          num_parts: int):
+        """Contiguous row-group ranges by the InputSplit byte rule at
+        group granularity — THE shared partition contract with the
+        native engine's ParquetShardReader (engine.cc), so sharded and
+        part-split parses agree across engines: group g belongs to
+        part j iff its global byte start (file base + the group's
+        first page offset) lands in [j*nstep, (j+1)*nstep) with
+        nstep = ceil(total/num_parts). Empty groups are skipped on
+        both sides."""
+        groups = []
+        base = 0
+        for fi, (f, (_p, size)) in enumerate(zip(files, entries)):
+            md = f.metadata
+            for gi in range(md.num_row_groups):
+                rg = md.row_group(gi)
+                if rg.num_rows == 0:
+                    continue
+                span_lo = None
+                for c in range(rg.num_columns):
+                    col = rg.column(c)
+                    dpo = col.dictionary_page_offset
+                    start = (dpo if dpo and 0 < dpo < col.data_page_offset
+                             else col.data_page_offset)
+                    span_lo = start if span_lo is None \
+                        else min(span_lo, start)
+                if span_lo is None:
+                    span_lo = 4  # no columns: the native sentinel
+                groups.append((fi, gi, base + span_lo))
+            base += size
+        nstep = -(-base // num_parts) if base else 1
+        lo, hi = nstep * part_index, nstep * (part_index + 1)
+        return [(fi, gi) for fi, gi, g in groups if lo <= g < hi]
 
     @staticmethod
     def _open_source(path: str, size: int):
@@ -258,3 +304,24 @@ class ParquetParser(Parser):
 def _make_parquet(**kwargs):
     kwargs.pop("engine", None)
     return ParquetParser(**kwargs)
+
+
+def _parquet_golden(**kwargs):
+    """The pyarrow golden as a ``native_or`` fallback target: strip
+    the engine-only construction kwargs the text-parser fallbacks
+    absorb via TextParserBase."""
+    for k in ("nthreads", "chunk_size", "split_type", "prefetch_depth",
+              "split_factory", "engine"):
+        kwargs.pop(k, None)
+    return ParquetParser(**kwargs)
+
+
+@PARSER_REGISTRY.register(
+    "parquet_native",
+    description="parquet columnar — native page decoder (ABI 8: V1 "
+                "PLAIN/RLE-dictionary pages, i32/i64/f32/f64, "
+                "def-level nulls, UNCOMPRESSED/GZIP), pyarrow golden "
+                "fallback")
+def _make_parquet_native(**kwargs):
+    from dmlc_tpu.data.parser import native_or
+    return native_or("NativeParquetParser", _parquet_golden, kwargs)

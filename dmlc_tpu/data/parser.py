@@ -36,7 +36,9 @@ PARSER_REGISTRY = Registry.get("ParserFactory")
 _NATIVE_FORMATS = {"NativeLibSVMParser": "libsvm",
                    "NativeCSVParser": "csv",
                    "NativeLibFMParser": "libfm",
-                   "NativeDenseRecordParser": "recordio_dense"}
+                   "NativeDenseRecordParser": "recordio_dense",
+                   "NativeImageRecordParser": "recordio_image",
+                   "NativeParquetParser": "parquet"}
 
 
 def native_or(native_cls_name: str, python_cls, kwargs):
@@ -52,9 +54,13 @@ def native_or(native_cls_name: str, python_cls, kwargs):
     deterministic in-order block reassembly
     (bindings.NativeShardedTextParser) — a single large file then
     parallelizes its reader/reorder stages like a multi-file input,
-    byte-identical to the 1-parser stream. The python golden (and a
-    part of a wider split) runs unsharded — shards is a pure
-    performance knob, never a semantics change.
+    byte-identical to the 1-parser stream. The columnar lane shards
+    too (ABI 8): ``format="parquet_native"`` partitions at ROW-GROUP
+    granularity (the same byte rule applied at group starts, shared
+    with the golden's ``_partition_groups``), so sharded parquet
+    streams concatenate byte-identical exactly like text/recordio.
+    The python golden (and a part of a wider split) runs unsharded —
+    shards is a pure performance knob, never a semantics change.
     """
     engine = kwargs.get("engine", "auto")
     shards = int(kwargs.pop("shards", 1) or 1)

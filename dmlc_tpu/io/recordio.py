@@ -31,6 +31,20 @@ A payload whose length is not exactly ``8 + 4 * n_values`` is corrupt
 and must raise DMLCError (the engine raises EngineError) — never a
 silently short row. ``DenseRecordWriter``/:func:`decode_dense_record`
 are the round-trip pair the parity tests pin.
+
+Image-record payload encoding (ABI 8, frozen — the native engine's
+``recordio_image`` decoder and the Python golden
+``data/image_record_parser.py`` both speak exactly this; the MXNet-
+style ImageNet ``.rec`` scenario's raw/uniform-shape lane)::
+
+    u32 h (LE) | u32 w (LE) | u32 c (LE) | f32 label (LE) |
+    u8[h*w*c] pixels (HWC, row-major)
+
+Same strict length contract: a payload whose byte length is not
+exactly ``16 + h*w*c`` raises DMLCError/EngineError. Pixel bytes that
+happen to spell the frame magic at a 4-aligned position escape into
+multi-frame records exactly like any other payload — the framing layer
+owns that, both decoders stitch it back.
 """
 
 from __future__ import annotations
@@ -47,6 +61,7 @@ __all__ = [
     "RECORDIO_MAGIC", "RecordIOWriter", "RecordIOReader",
     "RecordIOChunkReader", "encode_lrec", "decode_flag", "decode_length",
     "DenseRecordWriter", "encode_dense_record", "decode_dense_record",
+    "ImageRecordWriter", "encode_image_record", "decode_image_record",
 ]
 
 RECORDIO_MAGIC = 0xced7230a
@@ -199,6 +214,61 @@ class DenseRecordWriter:
 
     def write(self, label: float, values) -> None:
         self._w.write_record(encode_dense_record(label, values))
+
+
+_IMAGE_HDR = struct.Struct("<IIIf")  # h, w, c, label
+
+
+def encode_image_record(label: float, pixels) -> bytes:
+    """One image record payload: ``u32 h | u32 w | u32 c | f32 label |
+    u8[h*w*c] pixels`` (HWC row-major, all little-endian). ``pixels``
+    is any array-like coercible to a 3-D uint8 HWC array (a 2-D
+    grayscale array gains a trailing channel axis of 1)."""
+    px = np.ascontiguousarray(pixels, dtype=np.uint8)
+    if px.ndim == 2:
+        px = px[:, :, None]
+    check(px.ndim == 3, "image record: pixels must be HWC (or HW)")
+    h, w, c = px.shape
+    return _IMAGE_HDR.pack(h, w, c, float(label)) + px.tobytes()
+
+
+def decode_image_record(payload) -> Tuple[np.float32, np.ndarray]:
+    """Decode one image payload to ``(label, pixels)`` — pixels an
+    ``[h, w, c]`` uint8 view over the payload bytes. The length
+    contract is strict: a payload whose byte length disagrees with its
+    recorded shape raises DMLCError (byte parity with the engine's
+    EngineError)."""
+    n_bytes = len(payload)
+    check(n_bytes >= _IMAGE_HDR.size,
+          f"image record: payload shorter than its 16-byte header "
+          f"({n_bytes} bytes)")
+    h, w, c, label = _IMAGE_HDR.unpack_from(payload)
+    npix = h * w * c
+    check(n_bytes == _IMAGE_HDR.size + npix,
+          f"image record: shape {h}x{w}x{c} disagrees with payload "
+          f"length {n_bytes}")
+    pixels = np.frombuffer(payload, dtype=np.uint8, count=npix,
+                           offset=_IMAGE_HDR.size).reshape(h, w, c)
+    return np.float32(label), pixels
+
+
+class ImageRecordWriter:
+    """RecordIO writer of raw HWC u8 image records — the Python golden
+    for the engine's ABI-8 ``recordio_image`` decode lane (the MXNet-
+    style ``.rec`` shape, raw/uniform pixels). Pixel runs that spell
+    the frame magic at a 4-aligned payload position escape into
+    multi-frame records via :class:`RecordIOWriter`, decoders stitch
+    them back."""
+
+    def __init__(self, stream: Stream):
+        self._w = RecordIOWriter(stream)
+
+    @property
+    def escaped_magic_count(self) -> int:
+        return self._w.escaped_magic_count
+
+    def write(self, label: float, pixels) -> None:
+        self._w.write_record(encode_image_record(label, pixels))
 
 
 class RecordIOReader:

@@ -26,7 +26,8 @@ from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = ["load", "NativeTextParser", "NativeLibSVMParser",
            "NativeCSVParser", "NativeLibFMParser",
-           "NativeDenseRecordParser", "NativeShardedTextParser",
+           "NativeDenseRecordParser", "NativeImageRecordParser",
+           "NativeParquetParser", "NativeShardedTextParser",
            "NativeRecordIOReader", "NativeIndexedRecordIOReader",
            "native_parse_float32", "columns_interleave", "prof_read"]
 
@@ -42,8 +43,12 @@ _lib = None
 # same arena/NextPadded machinery; 7: phase beacons for the sampling
 # profiler — dtp_prof_read snapshots every engine worker's seqlock-
 # stamped {phase, shard} slot, dtp_parser_set_shard tags sharded
-# sub-parsers for the merged flamegraph).
-ABI_VERSION = 7
+# sub-parsers for the merged flamegraph; 8: columnar-page +
+# image-payload decode — dtp_parser_create accepts formats "parquet"
+# (native row-group page decoder) and "recordio_image" (frozen HWC u8
+# payloads), and grew two trailing label_name/weight_name args since
+# parquet addresses columns by NAME).
+ABI_VERSION = 8
 
 
 def load(path: str):
@@ -63,6 +68,7 @@ def load(path: str):
         C.POINTER(C.c_char_p), C.POINTER(C.c_int64), C.c_int64, C.c_int64,
         C.c_int64, C.c_char_p, C.c_int, C.c_int64, C.c_int, C.c_int64,
         C.c_int64, C.c_char, C.c_int,
+        C.c_char_p, C.c_char_p,  # ABI 8: parquet label/weight names
     ]
     lib.dtp_parser_next.restype = C.c_int64
     lib.dtp_parser_next.argtypes = [
@@ -348,7 +354,9 @@ class NativeTextParser(Parser):
             self._format.encode(), int(nthreads), int(chunk_size),
             int(self._indexing_mode), int(self._label_column),
             int(self._weight_column), self._delimiter.encode()[:1],
-            int(self._sparse))
+            int(self._sparse),
+            self._label_name.encode() if self._label_name else None,
+            self._weight_name.encode() if self._weight_name else None)
         if not self._handle:
             raise DMLCError(
                 f"native parser create failed: "
@@ -400,6 +408,8 @@ class NativeTextParser(Parser):
     _weight_column = -1
     _delimiter = ","
     _sparse = False
+    _label_name = None   # parquet: columns are addressed by NAME
+    _weight_name = None
 
     def _configure(self, kwargs: Dict[str, Any]) -> Optional[str]:
         self._indexing_mode = int(kwargs.pop("indexing_mode", 0))
@@ -950,9 +960,68 @@ class NativeDenseRecordParser(NativeTextParser):
         return super()._configure(kwargs)
 
 
+class NativeImageRecordParser(NativeTextParser):
+    """Dense image-payload decode over the native pipeline (ABI 8):
+    the MXNet-style ``.rec`` scenario's decoded lane. The engine's
+    RecordIOShardReader realigns the shard by magic scan and the parse
+    pool decodes each record's frozen image payload
+    (``u32 h | u32 w | u32 c | f32 label | u8[h*w*c]`` HWC pixels —
+    io/recordio.py) straight into CSR rows: indices are the pixel
+    ordinals, values the pixels widened u8 -> f32 (exact). Byte parity
+    with the Python golden (data/image_record_parser.py) is by
+    construction; ``next_padded`` feeds the same ABI-5/6 device-layout
+    lease path, so ``batch(pad=True)`` emits decoded fixed-shape
+    batches with zero Python row-byte touches."""
+
+    _format = "recordio_image"
+
+    def _configure(self, kwargs):
+        split_type = kwargs.pop("split_type", "recordio")
+        if split_type != "recordio":
+            return (f"recordio_image: split_type must be 'recordio', "
+                    f"got {split_type!r}")
+        return super()._configure(kwargs)
+
+
+class NativeParquetParser(NativeTextParser):
+    """Parquet columnar-page decode over the native pipeline (ABI 8):
+    one chunk is one ROW GROUP's contiguous byte span, decoded on a
+    pool worker — V1 PLAIN/RLE-dictionary data pages, physical types
+    i32/i64/f32/f64, def-level nulls (NaN), UNCOMPRESSED + GZIP pages.
+    Emission matches the pyarrow golden's dense path byte for byte
+    (data/parquet_parser.py): feature columns in schema order, label/
+    weight by name. Anything outside that matrix — nested or byte-array
+    columns, snappy/zstd pages, V2 data pages, ``sparse=True`` — fails
+    create with a NAMED error, so ``engine="auto"`` falls back to the
+    pyarrow golden loudly-at-build, never wrongly-at-decode. Row-group-
+    aligned ``shards=N`` byte-range partition means sharded parses
+    concatenate byte-identical to the 1-parser stream (the text/
+    recordio contract), through the same ABI-6 gang padded assembly."""
+
+    _format = "parquet"
+    decode_path = "native-page"  # obs/analyze decode evidence
+
+    def _configure(self, kwargs):
+        self._label_name = str(kwargs.pop("label_column", "") or "")
+        self._weight_name = str(kwargs.pop("weight_column", "") or "")
+        kwargs.pop("split_type", None)
+        if kwargs.pop("sparse", False):
+            return ("parquet: sparse (zero-dropping) decode is not "
+                    "native; engine='auto' falls back to the pyarrow "
+                    "golden")
+        kwargs.pop("engine", None)
+        kwargs.pop("prefetch", None)
+        kwargs.pop("format", None)
+        if kwargs:
+            return f"native parquet: unknown parameter(s) {sorted(kwargs)}"
+        return None
+
+
 _SHARDED_FORMATS = {"libsvm": NativeLibSVMParser, "csv": NativeCSVParser,
                     "libfm": NativeLibFMParser,
-                    "recordio_dense": NativeDenseRecordParser}
+                    "recordio_dense": NativeDenseRecordParser,
+                    "recordio_image": NativeImageRecordParser,
+                    "parquet": NativeParquetParser}
 
 
 class NativeShardedTextParser(Parser):
@@ -1007,6 +1076,8 @@ class NativeShardedTextParser(Parser):
             cls(uri, j, self.shards, index_dtype=index_dtype,
                 nthreads=per, chunk_size=chunk_size, **dict(kwargs))
             for j in range(self.shards)]
+        # decode-path evidence passes through (parquet subs carry it)
+        self.decode_path = getattr(self._subs[0], "decode_path", None)
         for j, p in enumerate(self._subs):
             # tag each sub's ABI-7 phase beacons with its shard, so
             # the sampling profiler's merged flamegraph labels carry

@@ -1,15 +1,16 @@
 """Build the native engine: ``python -m dmlc_tpu.native.build``.
 
 Compiles native/src/engine.cc into libdmlc_tpu.so next to this file
-(g++ -O3; no external deps). The reference's CMake/Makefile build glue
+(g++ -O3; zlib when the host has it — the Parquet GZIP page codec —
+no other external deps). The reference's CMake/Makefile build glue
 (CMakeLists.txt, make/dmlc.mk) maps to this single-step build plus
 pyproject.toml for the Python side.
 
-The build ASSERTS the compiled engine's ABI (``dtp_version()``, 7
-since the profiler phase beacons) equals ``bindings.ABI_VERSION`` in a
-subprocess probe — a stale source tree or .so fails the BUILD loudly
-instead of engine="auto" callers silently falling back to the python
-golden at first use.
+The build ASSERTS the compiled engine's ABI (``dtp_version()``, 8
+since the columnar-page + image-payload decode) equals
+``bindings.ABI_VERSION`` in a subprocess probe — a stale source tree
+or .so fails the BUILD loudly instead of engine="auto" callers
+silently falling back to the python golden at first use.
 """
 
 from __future__ import annotations
@@ -23,12 +24,46 @@ SRC = os.path.join(HERE, "src", "engine.cc")
 OUT = os.path.join(HERE, "libdmlc_tpu.so")
 
 
+_ZLIB_FLAGS = None
+
+
+def zlib_flags() -> list:
+    """``["-lz"]`` when the toolchain can compile AND link against
+    zlib (the engine's Parquet GZIP page decode), else
+    ``["-DDTP_NO_ZLIB"]`` — the engine builds either way; without
+    zlib, GZIP-coded pages raise EngineError naming the rebuild.
+    Decided by a trial compile+link (not a header-path guess: SDK/
+    sysroot layouts put zlib.h where only the compiler can see it,
+    and engine.cc's own ``__has_include`` probe must agree with the
+    link line or the build breaks one way or the other). Shared with
+    the test-binary builds (tests/test_native.py) so every target
+    links the same way; cached per process."""
+    global _ZLIB_FLAGS
+    if _ZLIB_FLAGS is not None:
+        return list(_ZLIB_FLAGS)
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="dtp_zlib_probe_") as d:
+        src = os.path.join(d, "probe.cc")
+        with open(src, "w") as f:
+            f.write("#include <zlib.h>\n"
+                    "int main() { return zlibVersion() == nullptr; }\n")
+        try:
+            ok = subprocess.run(
+                ["g++", "-std=c++17", src, "-o",
+                 os.path.join(d, "probe"), "-lz"],
+                capture_output=True, timeout=60).returncode == 0
+        except (OSError, subprocess.SubprocessError):
+            ok = False
+    _ZLIB_FLAGS = ["-lz"] if ok else ["-DDTP_NO_ZLIB"]
+    return list(_ZLIB_FLAGS)
+
+
 def build(verbose: bool = True) -> str:
     cmd = [
         "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
         "-pthread", "-Wall", "-Wextra",
         SRC, "-o", OUT,
-    ]
+    ] + zlib_flags()
     if verbose:
         print("+", " ".join(cmd))
     subprocess.run(cmd, check=True)

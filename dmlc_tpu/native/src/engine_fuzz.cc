@@ -8,6 +8,7 @@
 
 #include "engine.cc"
 #include "recordio_test_util.h"
+#include "parquet_test_util.h"
 
 #include <cstdio>
 #include <random>
@@ -221,6 +222,147 @@ int fuzz_dense(const std::string& base, int iters) {
   return threw;
 }
 
+// ABI-8 image decode under corruption: shape/length disagreements,
+// truncated frames, garbage — reject via EngineError, never OOB
+int fuzz_image(const std::string& base, int iters) {
+  int threw = 0;
+  for (int i = 0; i < iters; ++i) {
+    std::string data = base;
+    for (int m = (int)(g_rng() % 6); m >= 0; --m) mutate(&data);
+    CSRArena a;
+    try {
+      ParseRecIOImageSlice(data.data(), data.size(), &a);
+    } catch (const EngineError&) {
+      ++threw;
+    }
+  }
+  return threw;
+}
+
+// image corpus: valid framed image payloads, a few with aligned
+// in-pixel magic bytes so the escaped multi-frame stitch runs in the
+// unmutated base too
+std::string make_image_recordio(int records) {
+  std::string out;
+  for (int i = 0; i < records; ++i) {
+    uint32_t h = 1 + (uint32_t)(g_rng() % 6);
+    uint32_t w = 1 + (uint32_t)(g_rng() % 6);
+    uint32_t c = 1 + (uint32_t)(g_rng() % 3);
+    std::vector<uint8_t> px(h * w * c);
+    for (auto& p : px) p = (uint8_t)(g_rng() & 0xff);
+    if (px.size() >= 8 && i % 5 == 0)
+      std::memcpy(px.data() + 4, &kRecIOMagic, 4);  // 16+4 is aligned
+    float label = (float)(int)(g_rng() % 9) - 4.0f;
+    append_recordio_record(&out, image_payload(h, w, c, label, px));
+  }
+  return out;
+}
+
+// ABI-8 parquet corpus: one small valid file (dictionary + plain +
+// null-bearing pages) built by the shared test writer
+std::string make_parquet_file() {
+  PqTestColumn lab;
+  lab.name = "label";
+  std::vector<float> lv(24);
+  for (auto& v : lv) v = (float)(g_rng() % 3);
+  pq_add_plain_page(&lab, lv, {});
+  PqTestColumn f0;
+  f0.name = "f0";
+  pq_add_dict_page(&f0, {1.5f, -2.5f, 3.5f, 0.0f, 9.25f});
+  std::vector<uint32_t> idx, defs;
+  for (int i = 0; i < 24; ++i) {
+    defs.push_back(g_rng() % 4 ? 1u : 0u);
+    if (defs.back()) idx.push_back((uint32_t)(g_rng() % 5));
+  }
+  pq_add_dict_data_page(&f0, idx, defs, 3);
+  PqTestColumn f1;
+  f1.name = "f1";
+  std::vector<float> pv;
+  std::vector<uint32_t> d2;
+  for (int i = 0; i < 24; ++i) {
+    d2.push_back(g_rng() % 5 ? 1u : 0u);
+    if (d2.back()) pv.push_back((float)(g_rng() % 1000) / 8.0f);
+  }
+  pq_add_plain_page(&f1, pv, d2);
+  return pq_build_file({lab, f0, f1}, 24);
+}
+
+// footer/metadata fuzz: mutate the WHOLE file, write to a temp path,
+// PqParseFooter must parse-or-throw (the thrift walker's bounds are
+// what ASAN is pointed at)
+int fuzz_parquet_footer(const std::string& base, int iters) {
+  int threw = 0;
+  char tmpl[] = "/tmp/dtp_fuzz_parquet_XXXXXX";
+  int tfd = mkstemp(tmpl);
+  if (tfd < 0) return -1;
+  for (int i = 0; i < iters; ++i) {
+    std::string data = base;
+    for (int m = (int)(g_rng() % 6); m >= 0; --m) mutate(&data);
+    if (ftruncate(tfd, 0) != 0 ||
+        pwrite(tfd, data.data(), data.size(), 0) !=
+            (ssize_t)data.size())
+      return -1;
+    try {
+      PqFileMeta fm = PqParseFooter(tmpl);
+      (void)fm;
+    } catch (const EngineError&) {
+      ++threw;
+    }
+  }
+  close(tfd);
+  unlink(tmpl);
+  return threw;
+}
+
+// page-byte fuzz: the footer stays VALID (parsed once), the row
+// group's page bytes mutate — truncated/corrupt pages, bad def runs,
+// out-of-range dictionary indices must all reject, never shift bytes
+// or touch memory out of bounds
+int fuzz_parquet_pages(const std::string& base, int iters) {
+  char tmpl[] = "/tmp/dtp_fuzz_pqpage_XXXXXX";
+  int tfd = mkstemp(tmpl);
+  if (tfd < 0) return -1;
+  if (pwrite(tfd, base.data(), base.size(), 0) != (ssize_t)base.size())
+    return -1;
+  ParquetMeta M;
+  M.files.push_back(PqParseFooter(tmpl));
+  close(tfd);
+  unlink(tmpl);
+  M.label_col = 0;
+  for (size_t c = 1; c < M.files[0].leaves.size(); ++c)
+    M.feat_cols.push_back((int)c);
+  M.part_groups = {{0, 0}};
+  const PqRowGroup& rg = M.files[0].groups[0];
+  size_t span = (size_t)(rg.span_hi - rg.span_lo);
+  int threw = 0;
+  for (int i = 0; i < iters; ++i) {
+    std::string data = base.substr((size_t)rg.span_lo, span);
+    bool valid_half = (i % 4 == 0);  // accept paths run under ASAN too
+    if (!valid_half)
+      for (int m = (int)(g_rng() % 6); m >= 0; --m) {
+        // mutate in place only (no truncation: the span length is the
+        // reader's contract; short spans are exercised separately)
+        size_t pos = g_rng() % data.size();
+        data[pos] = (char)(g_rng() & 0xff);
+      }
+    CSRArena a;
+    try {
+      ParseParquetGroupSlice(M, 0, data.data(), data.size(), &a);
+    } catch (const EngineError&) {
+      ++threw;
+    }
+    // truncated span: always rejects, never OOB
+    CSRArena a2;
+    try {
+      ParseParquetGroupSlice(M, 0, data.data(),
+                             g_rng() % (data.size() + 1), &a2);
+    } catch (const EngineError&) {
+      ++threw;
+    }
+  }
+  return threw;
+}
+
 int fuzz_recordio(const std::string& base, int iters) {
   int threw = 0;
   for (int i = 0; i < iters; ++i) {
@@ -328,13 +470,20 @@ int main(int argc, char** argv) {
   // ABI-6 dense decode (incl. escaped-magic multi-frame records in
   // the unmutated base — the stitch path runs under ASAN too)
   int t9 = fuzz_dense(make_dense_recordio(60), iters);
+  // ABI-8 image decode + parquet footer/page corruption
+  int t10 = fuzz_image(make_image_recordio(60), iters);
+  std::string pqfile = make_parquet_file();
+  int t11 = fuzz_parquet_footer(pqfile, iters);
+  int t12 = fuzz_parquet_pages(pqfile, iters);
   // sanity: the corrupting fuzz must actually hit rejection paths
   std::printf("fuzz complete: rejects libsvm=%d csv=%d libfm=%d "
               "recordio=%d recidx=%d short=%d fixed6=%d csv6=%d "
-              "dense=%d of %d each\n",
-              t1, t2, t3, t4, t5, t6, t7, t8, t9, iters);
+              "dense=%d image=%d pqfooter=%d pqpages=%d of %d each\n",
+              t1, t2, t3, t4, t5, t6, t7, t8, t9, t10, t11, t12,
+              iters);
   if (t1 == 0 || t2 == 0 || t3 == 0 || t4 == 0 || t5 <= 0 || t6 == 0 ||
-      t7 == 0 || t8 == 0 || t9 == 0) {
+      t7 == 0 || t8 == 0 || t9 == 0 || t10 == 0 || t11 <= 0 ||
+      t12 <= 0) {
     std::fprintf(stderr, "fuzz too weak: no rejections seen\n");
     return 1;
   }

@@ -56,7 +56,8 @@ void* make_parser(const std::string& path, int nthreads) {
   const char* paths[1] = {path.c_str()};
   int64_t sizes[1] = {file_size(path)};
   return dtp_parser_create(paths, sizes, 1, 0, 1, "libsvm", nthreads,
-                           64 * 1024, 0, -1, -1, ',', 0);
+                           64 * 1024, 0, -1, -1, ',', 0, nullptr,
+                           nullptr);
 }
 
 int consume_some(void* h, int max_blocks, std::vector<void*>* leases) {

@@ -10,6 +10,7 @@
 
 #include "engine.cc"
 #include "recordio_test_util.h"
+#include "parquet_test_util.h"
 
 #include <cstdlib>
 #include <fstream>
@@ -550,6 +551,289 @@ static void test_block_cache() {
   ::operator delete(b);
 }
 
+// ------------------------------------------------ ABI-8 parquet decode
+
+static std::string write_tmp_file(const std::string& bytes,
+                                  const char* tag) {
+  std::string path = std::string("/tmp/dtp_unittest_") + tag + ".bin";
+  std::ofstream f(path, std::ios::binary | std::ios::trunc);
+  f.write(bytes.data(), (std::streamsize)bytes.size());
+  f.close();
+  return path;
+}
+
+// build a ParquetMeta over one just-built single-group file with
+// leaf 0 = label, the rest features
+static ParquetMeta pq_meta_of(const std::string& path) {
+  ParquetMeta M;
+  M.files.push_back(PqParseFooter(path));
+  M.label_col = 0;
+  for (size_t c = 1; c < M.files[0].leaves.size(); ++c)
+    M.feat_cols.push_back((int)c);
+  M.part_groups = {{0, 0}};
+  return M;
+}
+
+// bit-exact PLAIN decode incl. a def-level null bitmap: nulls land as
+// NaN, present values keep their exact f32 bits
+static void test_parquet_plain_decode() {
+  PqTestColumn lab;
+  lab.name = "label";
+  pq_add_plain_page(&lab, {1.0f, 0.0f, 1.0f, 0.0f, 1.0f}, {});
+  PqTestColumn f0;
+  f0.name = "f0";
+  pq_add_plain_page(&f0, {0.5f, -2.25f, 3e7f}, {1, 0, 1, 1, 0});
+  std::string file = pq_build_file({lab, f0}, 5);
+  std::string path = write_tmp_file(file, "pq_plain");
+  ParquetMeta M = pq_meta_of(path);
+  const PqRowGroup& rg = M.files[0].groups[0];
+  CSRArena a;
+  ParseParquetGroupSlice(M, 0, file.data() + rg.span_lo,
+                         (size_t)(rg.span_hi - rg.span_lo), &a);
+  CHECK_EQ_(a.rows(), 5u);
+  CHECK_EQ_(a.nnz(), 5u);
+  CHECK_EQ_(a.label[0], 1.0f);
+  CHECK_EQ_(a.label[3], 0.0f);
+  CHECK_EQ_(a.value[0], 0.5f);
+  CHECK_TRUE(std::isnan(a.value[1]));
+  CHECK_EQ_(a.value[2], -2.25f);
+  CHECK_EQ_(a.value[3], 3e7f);
+  CHECK_TRUE(std::isnan(a.value[4]));
+  CHECK_EQ_(a.max_index, 0u);
+  for (size_t r = 0; r <= 5; ++r)
+    CHECK_EQ_(a.offset[r], (int64_t)r);
+}
+
+// RLE-run def levels: a whole-page null RUN decodes to NaNs, a
+// whole-page present RUN to values — the two RLE (non-bit-packed)
+// hybrid forms
+static void test_parquet_null_runs() {
+  PqTestColumn lab;
+  lab.name = "label";
+  pq_add_plain_page(&lab, std::vector<float>(8, 2.0f), {});
+  PqTestColumn f0;
+  f0.name = "f0";
+  // page 1: 4 values, ALL null (RLE run of def 0)
+  pq_add_plain_page(&f0, {}, {0, 0, 0, 0}, /*rle_run_defs=*/true);
+  // page 2: 4 values, all present (RLE run of def 1)
+  pq_add_plain_page(&f0, {1.f, 2.f, 3.f, 4.f}, {1, 1, 1, 1},
+                    /*rle_run_defs=*/true);
+  std::string file = pq_build_file({lab, f0}, 8);
+  std::string path = write_tmp_file(file, "pq_nullrun");
+  ParquetMeta M = pq_meta_of(path);
+  const PqRowGroup& rg = M.files[0].groups[0];
+  CSRArena a;
+  ParseParquetGroupSlice(M, 0, file.data() + rg.span_lo,
+                         (size_t)(rg.span_hi - rg.span_lo), &a);
+  CHECK_EQ_(a.rows(), 8u);
+  for (int r = 0; r < 4; ++r) CHECK_TRUE(std::isnan(a.value[r]));
+  for (int r = 4; r < 8; ++r) CHECK_EQ_(a.value[r], (float)(r - 3));
+}
+
+// dictionary page + RLE_DICTIONARY pages + a PLAIN page in ONE chunk —
+// the writer fallback shape when a dictionary overflows mid-chunk
+static void test_parquet_dict_fallback() {
+  PqTestColumn lab;
+  lab.name = "label";
+  pq_add_plain_page(&lab, std::vector<float>(6, 0.0f), {});
+  PqTestColumn f0;
+  f0.name = "f0";
+  pq_add_dict_page(&f0, {10.5f, -7.0f, 0.25f, 99.0f});
+  pq_add_dict_data_page(&f0, {3, 0, 2}, {1, 1, 0, 1}, 2);  // 1 null
+  pq_add_plain_page(&f0, {5.5f, 6.5f}, {1, 1});
+  std::string file = pq_build_file({lab, f0}, 6);
+  std::string path = write_tmp_file(file, "pq_dictfall");
+  ParquetMeta M = pq_meta_of(path);
+  const PqRowGroup& rg = M.files[0].groups[0];
+  CSRArena a;
+  ParseParquetGroupSlice(M, 0, file.data() + rg.span_lo,
+                         (size_t)(rg.span_hi - rg.span_lo), &a);
+  CHECK_EQ_(a.rows(), 6u);
+  CHECK_EQ_(a.value[0], 99.0f);
+  CHECK_EQ_(a.value[1], 10.5f);
+  CHECK_TRUE(std::isnan(a.value[2]));
+  CHECK_EQ_(a.value[3], 0.25f);
+  CHECK_EQ_(a.value[4], 5.5f);
+  CHECK_EQ_(a.value[5], 6.5f);
+}
+
+#ifdef DTP_HAVE_ZLIB
+static void test_parquet_gzip_pages() {
+  PqTestColumn lab;
+  lab.name = "label";
+  lab.codec = 2;  // GZIP
+  pq_add_plain_page(&lab, {3.0f, 4.0f, 5.0f}, {});
+  PqTestColumn f0;
+  f0.name = "f0";
+  f0.codec = 2;
+  pq_add_plain_page(&f0, {1.25f, -1.25f}, {1, 0, 1});
+  std::string file = pq_build_file({lab, f0}, 3);
+  std::string path = write_tmp_file(file, "pq_gzip");
+  ParquetMeta M = pq_meta_of(path);
+  const PqRowGroup& rg = M.files[0].groups[0];
+  CSRArena a;
+  ParseParquetGroupSlice(M, 0, file.data() + rg.span_lo,
+                         (size_t)(rg.span_hi - rg.span_lo), &a);
+  CHECK_EQ_(a.rows(), 3u);
+  CHECK_EQ_(a.label[2], 5.0f);
+  CHECK_EQ_(a.value[0], 1.25f);
+  CHECK_TRUE(std::isnan(a.value[1]));
+  CHECK_EQ_(a.value[2], -1.25f);
+}
+#endif
+
+// corruption must REJECT via EngineError — never shifted values
+static void test_parquet_rejects() {
+  PqTestColumn lab;
+  lab.name = "label";
+  pq_add_plain_page(&lab, {1.0f, 2.0f}, {});
+  PqTestColumn f0;
+  f0.name = "f0";
+  pq_add_dict_page(&f0, {10.0f, 20.0f});
+  pq_add_dict_data_page(&f0, {1, 7}, {1, 1}, 3);  // index 7 of 2: bad
+  std::string file = pq_build_file({lab, f0}, 2);
+  std::string path = write_tmp_file(file, "pq_badidx");
+  ParquetMeta M = pq_meta_of(path);
+  const PqRowGroup& rg = M.files[0].groups[0];
+  CSRArena a;
+  bool threw = false;
+  try {
+    ParseParquetGroupSlice(M, 0, file.data() + rg.span_lo,
+                           (size_t)(rg.span_hi - rg.span_lo), &a);
+  } catch (const EngineError& e) {
+    threw = e.msg.find("dictionary index") != std::string::npos;
+  }
+  CHECK_TRUE(threw);
+  // truncated footer: every prefix parses-or-throws, never OOB
+  bool threw2 = false;
+  try {
+    std::string trunc = file.substr(0, file.size() - 6);
+    PqParseFooter(write_tmp_file(trunc, "pq_trunc"));
+  } catch (const EngineError&) {
+    threw2 = true;
+  }
+  CHECK_TRUE(threw2);
+  // num_rows disagreeing with column num_values rejects at footer
+  bool threw3 = false;
+  try {
+    PqTestColumn c2;
+    c2.name = "label";
+    pq_add_plain_page(&c2, {1.0f, 2.0f}, {});
+    PqParseFooter(
+        write_tmp_file(pq_build_file({c2}, 5), "pq_shortcol"));
+  } catch (const EngineError&) {
+    threw3 = true;
+  }
+  CHECK_TRUE(threw3);
+  // truncated page run: column ends short of the row group
+  bool threw4 = false;
+  try {
+    std::string cut = file;
+    // chop the tail of the LAST column chunk's bytes (pages region)
+    ParquetMeta M2 = pq_meta_of(path);
+    const PqRowGroup& rg2 = M2.files[0].groups[0];
+    CSRArena a2;
+    ParseParquetGroupSlice(M2, 0, file.data() + rg2.span_lo,
+                           (size_t)(rg2.span_hi - rg2.span_lo) / 2,
+                           &a2);
+  } catch (const EngineError&) {
+    threw4 = true;
+  }
+  CHECK_TRUE(threw4);
+}
+
+// the whole C ABI path: create on real files, next, byte checks
+static void test_parquet_abi_end_to_end() {
+  PqTestColumn lab;
+  lab.name = "y";
+  pq_add_plain_page(&lab, {7.0f, 8.0f, 9.0f}, {});
+  PqTestColumn f0;
+  f0.name = "f0";
+  pq_add_plain_page(&f0, {0.5f, 1.5f, 2.5f}, {});
+  PqTestColumn f1;
+  f1.name = "f1";
+  pq_add_plain_page(&f1, {-1.0f, -2.0f, -3.0f}, {});
+  std::string file = pq_build_file({lab, f0, f1}, 3);
+  std::string path = write_tmp_file(file, "pq_abi");
+  const char* paths[1] = {path.c_str()};
+  int64_t sizes[1] = {(int64_t)file.size()};
+  void* h = dtp_parser_create(paths, sizes, 1, 0, 1, "parquet", 1,
+                              1 << 20, 0, -1, -1, ',', 0, "y", nullptr);
+  CHECK_TRUE(h != nullptr);
+  if (!h) return;
+  void* block;
+  const int64_t *offset, *qid, *field;
+  const float *label, *weight, *value;
+  const uint32_t* i32;
+  const uint64_t* i64;
+  int64_t nnz;
+  int hw, hq, hf;
+  int64_t rows = dtp_parser_next(h, &block, &offset, &label, &weight,
+                                 &qid, &i32, &i64, &value, &field, &nnz,
+                                 &hw, &hq, &hf);
+  CHECK_EQ_(rows, 3);
+  CHECK_EQ_(nnz, 6);
+  CHECK_EQ_(label[1], 8.0f);
+  CHECK_EQ_(value[0], 0.5f);
+  CHECK_EQ_(value[1], -1.0f);
+  CHECK_EQ_(value[4], 2.5f);
+  CHECK_EQ_(value[5], -3.0f);
+  CHECK_EQ_(i32[0], 0u);
+  CHECK_EQ_(i32[1], 1u);
+  CHECK_EQ_(hw, 0);
+  dtp_block_release(h, block);
+  rows = dtp_parser_next(h, &block, &offset, &label, &weight, &qid,
+                         &i32, &i64, &value, &field, &nnz, &hw, &hq,
+                         &hf);
+  CHECK_EQ_(rows, 0);
+  dtp_parser_destroy(h);
+}
+
+// ------------------------------------------- ABI-8 image decode
+
+static void test_image_decode() {
+  std::string chunk;
+  std::vector<uint8_t> px = {0, 1, 2, 3, 4, 5, 250, 251, 252, 253, 254,
+                             255};
+  append_recordio_record(&chunk, image_payload(2, 2, 3, 1.5f, px));
+  // escaped-magic pixels: the 4 magic bytes at a 4-aligned payload
+  // position (16-byte header keeps pixel offsets aligned)
+  std::vector<uint8_t> px2(24, 7);
+  std::memcpy(px2.data() + 4, &kRecIOMagic, 4);
+  append_recordio_record(&chunk, image_payload(2, 3, 4, -2.0f, px2));
+  CSRArena a;
+  ParseRecIOImageSlice(chunk.data(), chunk.size(), &a);
+  CHECK_EQ_(a.rows(), 2u);
+  CHECK_EQ_(a.nnz(), 36u);
+  CHECK_EQ_(a.label[0], 1.5f);
+  CHECK_EQ_(a.label[1], -2.0f);
+  CHECK_EQ_(a.value[0], 0.0f);
+  CHECK_EQ_(a.value[11], 255.0f);
+  for (int k = 0; k < 12; ++k) CHECK_EQ_(a.index32[k], (uint32_t)k);
+  // the magic bytes survive the stitch as pixel values
+  const uint8_t* m = (const uint8_t*)&kRecIOMagic;
+  for (int k = 0; k < 4; ++k)
+    CHECK_EQ_(a.value[12 + 4 + k], (float)m[k]);
+  CHECK_EQ_(a.value[12 + 3], 7.0f);
+  CHECK_EQ_(a.value[12 + 8], 7.0f);
+  CHECK_EQ_(a.max_index, 23u);
+  // strict shape contract: a shape/length mismatch REJECTS
+  std::string bad;
+  append_recordio_record(&bad, image_payload(2, 2, 3, 0.0f,
+                                             std::vector<uint8_t>(12)));
+  // corrupt the declared width after framing (payload starts at +8)
+  uint32_t w = 5;
+  std::memcpy(bad.data() + 8 + 4, &w, 4);
+  CSRArena a2;
+  bool threw = false;
+  try {
+    ParseRecIOImageSlice(bad.data(), bad.size(), &a2);
+  } catch (const EngineError& e) {
+    threw = e.msg.find("disagrees") != std::string::npos;
+  }
+  CHECK_TRUE(threw);
+}
+
 int main() {
   // the cache-cap assertions below assume the default 512 MB budget;
   // BlockCache::I() reads the env once at first use, which is here
@@ -566,6 +850,15 @@ int main() {
   test_recordio_shard_coverage();
   test_dense_decode();
   test_dense_shard_coverage();
+  test_parquet_plain_decode();
+  test_parquet_null_runs();
+  test_parquet_dict_fallback();
+#ifdef DTP_HAVE_ZLIB
+  test_parquet_gzip_pages();
+#endif
+  test_parquet_rejects();
+  test_parquet_abi_end_to_end();
+  test_image_decode();
   if (g_failures) {
     std::cerr << g_failures << " native unit-test failures\n";
     return 1;

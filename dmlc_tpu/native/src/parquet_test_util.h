@@ -1,0 +1,358 @@
+// Shared test-side Parquet writer helpers (engine_unittest.cc +
+// engine_fuzz.cc): a minimal thrift-compact writer + V1 page/footer
+// builder producing byte streams the ABI-8 columnar-page decoder must
+// accept — so the unit tests can pin bit-exact decode (null runs,
+// dictionary fallback-to-PLAIN, gzip pages) and the fuzzer can mutate
+// every byte of a VALID file. Writer-side only and deliberately tiny:
+// FLOAT/INT64 columns, one row group per file unless asked otherwise.
+// Include AFTER engine.cc (uses TCReader's enums, PqInflate's zlib
+// gate, load_u32le).
+
+#ifndef DMLC_TPU_PARQUET_TEST_UTIL_H_
+#define DMLC_TPU_PARQUET_TEST_UTIL_H_
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// ------------------------------------------------- thrift compact out
+struct TCWriter {
+  std::string out;
+  int16_t last_fid = 0;
+
+  void byte(uint8_t b) { out.push_back((char)b); }
+
+  void varint(uint64_t v) {
+    while (v >= 0x80) {
+      byte((uint8_t)(v | 0x80));
+      v >>= 7;
+    }
+    byte((uint8_t)v);
+  }
+
+  void zig(int64_t v) {
+    varint(((uint64_t)v << 1) ^ (uint64_t)(v >> 63));
+  }
+
+  // field header (short form; fids here are all small and ascending)
+  void field(int16_t fid, int type) {
+    int delta = fid - last_fid;
+    byte((uint8_t)((delta << 4) | type));
+    last_fid = fid;
+  }
+
+  void i32_field(int16_t fid, int64_t v) {
+    field(fid, 5);
+    zig(v);
+  }
+
+  void i64_field(int16_t fid, int64_t v) {
+    field(fid, 6);
+    zig(v);
+  }
+
+  void str_field(int16_t fid, const std::string& s) {
+    field(fid, 8);
+    varint(s.size());
+    out += s;
+  }
+
+  void list_field(int16_t fid, int etype, size_t n) {
+    field(fid, 9);
+    if (n < 15) {
+      byte((uint8_t)((n << 4) | etype));
+    } else {
+      byte((uint8_t)(0xf0 | etype));
+      varint(n);
+    }
+  }
+
+  void stop() {
+    byte(0);
+    last_fid = 0;
+  }
+};
+
+// ------------------------------------------------- level/value pieces
+
+// RLE/bit-packed hybrid bytes for small levels/indices: one
+// bit-packed run covering all n values (groups of 8, LSB-first)
+inline std::string pq_bitpack(const std::vector<uint32_t>& vals,
+                              int bw) {
+  size_t groups = (vals.size() + 7) / 8;
+  std::string body;
+  uint64_t header = (groups << 1) | 1;
+  while (header >= 0x80) {
+    body.push_back((char)(header | 0x80));
+    header >>= 7;
+  }
+  body.push_back((char)header);
+  std::string bits(groups * (size_t)bw, '\0');
+  size_t bitpos = 0;
+  for (size_t i = 0; i < vals.size(); ++i) {
+    for (int b = 0; b < bw; ++b, ++bitpos)
+      if ((vals[i] >> b) & 1)
+        bits[bitpos / 8] |= (char)(1 << (bitpos % 8));
+  }
+  return body + bits;
+}
+
+// RLE run form (for the null-RUN test: one literal repeated)
+inline std::string pq_rle_run(uint32_t value, int64_t count, int bw) {
+  std::string body;
+  uint64_t header = ((uint64_t)count << 1);
+  while (header >= 0x80) {
+    body.push_back((char)(header | 0x80));
+    header >>= 7;
+  }
+  body.push_back((char)header);
+  for (int i = 0; i < (bw + 7) / 8; ++i)
+    body.push_back((char)((value >> (8 * i)) & 0xff));
+  return body;
+}
+
+// def-level section of a V1 data page: u32 length + hybrid bytes
+inline std::string pq_def_section(const std::string& hybrid) {
+  uint32_t len = (uint32_t)hybrid.size();
+  std::string out(4, '\0');
+  std::memcpy(out.data(), &len, 4);
+  return out + hybrid;
+}
+
+// ------------------------------------------------------ page headers
+
+inline std::string pq_data_page_header(int64_t nv, int encoding,
+                                       int64_t unc, int64_t comp) {
+  TCWriter w;
+  w.i32_field(1, 0);  // type = DATA_PAGE
+  w.i32_field(2, unc);
+  w.i32_field(3, comp);
+  w.field(5, 12);  // data_page_header
+  {
+    TCWriter d;
+    d.i32_field(1, nv);
+    d.i32_field(2, encoding);
+    d.i32_field(3, 3);  // def: RLE
+    d.i32_field(4, 3);  // rep: RLE
+    d.stop();
+    w.out += d.out;
+  }
+  w.stop();
+  return w.out;
+}
+
+inline std::string pq_dict_page_header(int64_t nv, int64_t unc,
+                                       int64_t comp) {
+  TCWriter w;
+  w.i32_field(1, 2);  // type = DICTIONARY_PAGE
+  w.i32_field(2, unc);
+  w.i32_field(3, comp);
+  w.field(7, 12);  // dictionary_page_header
+  {
+    TCWriter d;
+    d.i32_field(1, nv);
+    d.i32_field(2, 0);  // PLAIN
+    d.stop();
+    w.out += d.out;
+  }
+  w.stop();
+  return w.out;
+}
+
+// optionally gzip a page body (returns the raw body when zlib is out)
+inline std::string pq_maybe_gzip(const std::string& raw, bool gzip) {
+#ifdef DTP_HAVE_ZLIB
+  if (!gzip) return raw;
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  // 15 + 16 = gzip framing, what parquet-cpp writes
+  if (deflateInit2(&zs, 6, Z_DEFLATED, 15 + 16, 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK)
+    return raw;
+  std::string out(raw.size() + 128, '\0');
+  zs.next_in = (Bytef*)raw.data();
+  zs.avail_in = (uInt)raw.size();
+  zs.next_out = (Bytef*)out.data();
+  zs.avail_out = (uInt)out.size();
+  int rc = deflate(&zs, Z_FINISH);
+  size_t n = out.size() - zs.avail_out;
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) return raw;
+  out.resize(n);
+  return out;
+#else
+  (void)gzip;
+  return raw;
+#endif
+}
+
+// ------------------------------------------------------- file builder
+
+// one column's page stream, built incrementally
+struct PqTestColumn {
+  std::string name;
+  int32_t phys = 4;  // FLOAT
+  bool optional = true;
+  int64_t num_values = 0;
+  int64_t dict_off_rel = -1;   // within the column's page bytes
+  std::string pages;           // concatenated header+body bytes
+  int32_t codec = 0;           // 0 uncompressed / 2 gzip
+};
+
+// append one PLAIN data page; defs empty = all present (still writes
+// the def section when the column is optional, like pyarrow)
+inline void pq_add_plain_page(PqTestColumn* col,
+                              const std::vector<float>& values,
+                              const std::vector<uint32_t>& defs_in,
+                              bool rle_run_defs = false) {
+  std::vector<uint32_t> defs = defs_in;
+  size_t nv = defs.empty() ? values.size() : defs.size();
+  if (defs.empty()) defs.assign(nv, 1);
+  std::string body;
+  if (col->optional)
+    body += pq_def_section(rle_run_defs
+                               ? pq_rle_run(defs[0], (int64_t)nv, 1)
+                               : pq_bitpack(defs, 1));
+  body.append((const char*)values.data(), values.size() * 4);
+  bool gz = col->codec == 2;
+  std::string wire = pq_maybe_gzip(body, gz);
+  col->pages += pq_data_page_header((int64_t)nv, 0,
+                                    (int64_t)body.size(),
+                                    (int64_t)wire.size());
+  col->pages += wire;
+  col->num_values += (int64_t)nv;
+}
+
+inline void pq_add_dict_page(PqTestColumn* col,
+                             const std::vector<float>& dict) {
+  std::string body((const char*)dict.data(), dict.size() * 4);
+  bool gz = col->codec == 2;
+  std::string wire = pq_maybe_gzip(body, gz);
+  col->dict_off_rel = (int64_t)col->pages.size();
+  col->pages += pq_dict_page_header((int64_t)dict.size(),
+                                    (int64_t)body.size(),
+                                    (int64_t)wire.size());
+  col->pages += wire;
+}
+
+inline void pq_add_dict_data_page(PqTestColumn* col,
+                                  const std::vector<uint32_t>& idx,
+                                  const std::vector<uint32_t>& defs_in,
+                                  int bw) {
+  std::vector<uint32_t> defs = defs_in;
+  size_t nv = defs.empty() ? idx.size() : defs.size();
+  if (defs.empty()) defs.assign(nv, 1);
+  std::string body;
+  if (col->optional) body += pq_def_section(pq_bitpack(defs, 1));
+  body.push_back((char)bw);
+  body += pq_bitpack(idx, bw);
+  bool gz = col->codec == 2;
+  std::string wire = pq_maybe_gzip(body, gz);
+  col->pages += pq_data_page_header((int64_t)nv, 8,  // RLE_DICTIONARY
+                                    (int64_t)body.size(),
+                                    (int64_t)wire.size());
+  col->pages += wire;
+  col->num_values += (int64_t)nv;
+}
+
+// assemble the whole file: "PAR1" + column pages + footer + len+magic
+inline std::string pq_build_file(std::vector<PqTestColumn> cols,
+                                 int64_t num_rows) {
+  std::string file = "PAR1";
+  std::vector<int64_t> starts, dicts, dpages;
+  for (auto& c : cols) {
+    int64_t start = (int64_t)file.size();
+    starts.push_back(start);
+    dicts.push_back(c.dict_off_rel >= 0 ? start + c.dict_off_rel : -1);
+    // data_page_offset: the column start for dict-less columns; a
+    // dict-leading column is fixed up below by walking the header
+    dpages.push_back(start);
+    file += c.pages;
+  }
+  // data_page_offset must point at the first DATA page; when a dict
+  // page leads, scan its header+body length by re-walking one header
+  for (size_t i = 0; i < cols.size(); ++i) {
+    if (dicts[i] < 0) continue;
+    // the dictionary is always written first by these helpers, so the
+    // first data page starts after it; find it by parsing the header
+    TCReader r(file.data() + dicts[i],
+               file.size() - (size_t)dicts[i]);
+    PqPageHeader ph = PqParsePageHeader(r);
+    dpages[i] =
+        (int64_t)((const char*)r.p - file.data()) + ph.comp_size;
+  }
+  TCWriter w;
+  w.i32_field(1, 2);  // version
+  w.list_field(2, 12, cols.size() + 1);  // schema
+  {
+    TCWriter root;
+    root.str_field(4, "schema");
+    root.i32_field(5, (int64_t)cols.size());
+    root.stop();
+    w.out += root.out;
+    for (auto& c : cols) {
+      TCWriter se;
+      se.i32_field(1, c.phys);
+      se.i32_field(3, c.optional ? 1 : 0);
+      se.str_field(4, c.name);
+      se.stop();
+      w.out += se.out;
+    }
+  }
+  w.i64_field(3, num_rows);
+  w.list_field(4, 12, 1);  // row_groups
+  {
+    TCWriter rg;
+    rg.list_field(1, 12, cols.size());  // columns
+    for (size_t i = 0; i < cols.size(); ++i) {
+      TCWriter cc;
+      cc.i64_field(2, starts[i]);  // (deprecated) file_offset
+      cc.field(3, 12);             // meta_data
+      {
+        TCWriter cm;
+        cm.i32_field(1, cols[i].phys);
+        cm.list_field(2, 5, 1);
+        cm.zig(0);  // encodings: PLAIN (informational)
+        cm.list_field(3, 8, 1);
+        cm.varint(cols[i].name.size());
+        cm.out += cols[i].name;
+        cm.i32_field(4, cols[i].codec);
+        cm.i64_field(5, cols[i].num_values);
+        cm.i64_field(6, (int64_t)cols[i].pages.size());
+        cm.i64_field(7, (int64_t)cols[i].pages.size());
+        cm.i64_field(9, dpages[i]);
+        if (dicts[i] >= 0) cm.i64_field(11, dicts[i]);
+        cm.stop();
+        cc.out += cm.out;
+      }
+      cc.stop();
+      rg.out += cc.out;
+    }
+    rg.i64_field(2, 0);  // total_byte_size (unused by the decoder)
+    rg.i64_field(3, num_rows);
+    rg.stop();
+    w.out += rg.out;
+  }
+  w.stop();
+  uint32_t mlen = (uint32_t)w.out.size();
+  file += w.out;
+  file.append((const char*)&mlen, 4);
+  file += "PAR1";
+  return file;
+}
+
+// one ABI-8 image payload: u32 h | u32 w | u32 c | f32 label | pixels
+inline std::string image_payload(uint32_t h, uint32_t w, uint32_t c,
+                                 float label,
+                                 const std::vector<uint8_t>& px) {
+  std::string p(16 + px.size(), '\0');
+  std::memcpy(&p[0], &h, 4);
+  std::memcpy(&p[4], &w, 4);
+  std::memcpy(&p[8], &c, 4);
+  std::memcpy(&p[12], &label, 4);
+  if (!px.empty()) std::memcpy(&p[16], px.data(), px.size());
+  return p;
+}
+
+#endif  // DMLC_TPU_PARQUET_TEST_UTIL_H_

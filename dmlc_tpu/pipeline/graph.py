@@ -168,6 +168,13 @@ def _finalize_parser(parser, probe) -> None:
         probe.extra["bytes_read"] = int(parser.bytes_read())
     except Exception:  # noqa: BLE001
         pass
+    # which decode path served the epoch (parquet: pyarrow golden vs
+    # the ABI-8 native page decoder) — obs/analyze's decode evidence
+    # names it with its measured GB/s, so a config-5-shaped DECODE-
+    # bound verdict says WHICH decoder was the wall
+    dp = getattr(parser, "decode_path", None)
+    if dp:
+        probe.extra["decode_path"] = dp
 
 
 class _ParseRunner(_RunnerBase):

@@ -272,6 +272,7 @@ def metric_lint(paths: List[str],
 IO_SEAM_ALLOWED = {
     "dmlc_tpu/bench_mp_worker.py",   # gang-worker result JSON
     "dmlc_tpu/bench_suite.py",       # corpus builders / BENCH JSON
+    "dmlc_tpu/native/build.py",      # build tooling (zlib link probe)
     "dmlc_tpu/obs/analyze.py",       # BENCH result JSON (compare)
     "dmlc_tpu/obs/export.py",        # trace JSON export
     "dmlc_tpu/obs/flight.py",        # crash flight bundles
@@ -358,6 +359,51 @@ def codec_lint(paths: List[str],
                 "dmlc_tpu.io.codec (encode_page/decode_page) so the "
                 "frame header, sidecar stamps and corruption handling "
                 "stay one contract")
+    return findings
+
+
+# pyarrow is a BOUNDARY, not a dependency (ABI 8): the native engine
+# decodes parquet pages itself, and the only package code allowed to
+# lean on pyarrow is the frozen golden (data/parquet_parser.py — the
+# byte-parity reference and the engine="auto" fallback) and
+# bench_suite.py's corpus makers. A pyarrow import anywhere else would
+# silently re-introduce the Python-bound decode wall the native lane
+# exists to remove — and break the package on hosts without pyarrow.
+# The list shrinks, it does not grow.
+ARROW_ALLOWED = {"dmlc_tpu/data/parquet_parser.py",
+                 "dmlc_tpu/bench_suite.py"}
+_ARROW_MODULES = {"pyarrow"}
+
+
+def arrow_lint(paths: List[str],
+               trees: Optional[dict] = None) -> List[str]:
+    """The pyarrow gate: imports confined to the parquet golden and
+    the bench corpus makers (see above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel in ARROW_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name.split(".")[0] for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                mods = [node.module.split(".")[0]]
+            hit = sorted(set(mods) & _ARROW_MODULES)
+            if hit:
+                findings.append(
+                    f"{rel}:{node.lineno}: pyarrow import outside "
+                    "data/parquet_parser.py — parquet decode goes "
+                    "through the parser registry (format "
+                    "'parquet_native': the ABI-8 native page decoder, "
+                    "pyarrow-golden fallback), never an ad-hoc arrow "
+                    "boundary")
     return findings
 
 
@@ -871,6 +917,7 @@ def main() -> int:
     findings += verdict_lint(paths, trees)
     findings += knob_lint(paths, trees)
     findings += codec_lint(paths, trees)
+    findings += arrow_lint(paths, trees)
     findings += profile_lint(paths, trees)
     findings += http_client_lint(paths, trees)
     ruff = run_ruff()

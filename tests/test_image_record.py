@@ -1,0 +1,267 @@
+"""Image-record decode (ABI 8): the frozen HWC u8 payload contract,
+the Python golden parser, native/python byte parity (incl.
+escaped-magic pixel runs and sharded parses), the fused padded
+pipeline producing DECODED fixed-shape batches, and the corruption
+contract (EngineError / DMLCError, never a crash or shifted pixels)."""
+
+import hashlib
+import struct
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io.recordio import (
+    RECORDIO_MAGIC, ImageRecordWriter, decode_image_record,
+    encode_image_record,
+)
+from dmlc_tpu.io.stream import create_stream
+from dmlc_tpu.utils.logging import DMLCError
+
+MAGIC_BYTES = np.frombuffer(struct.pack("<I", RECORDIO_MAGIC), np.uint8)
+
+
+def _write_images(path, records=200, shape=(8, 10, 3), seed=0,
+                  magic_every=13, ragged=False):
+    """Image corpus, optionally ragged shapes; every ``magic_every``-th
+    record carries the frame magic at a 4-aligned pixel offset (the
+    16-byte payload header keeps pixel offsets 4-aligned), so the
+    escaped multi-frame path runs inside the corpus."""
+    rng = np.random.default_rng(seed)
+    expect = []
+    with create_stream(str(path), "w") as s:
+        w = ImageRecordWriter(s)
+        for i in range(records):
+            hwc = shape
+            if ragged and i % 3 == 0:
+                hwc = (4 + i % 5, 6, 1 + i % 3)
+            px = rng.integers(0, 256, hwc, dtype=np.uint8)
+            if magic_every and i % magic_every == 0:
+                px.reshape(-1)[4:8] = MAGIC_BYTES
+            label = float(i % 7) - 3.0
+            w.write(label, px)
+            expect.append((np.float32(label), px))
+        escaped = w.escaped_magic_count
+    return expect, escaped
+
+
+def _stream_content(parser):
+    hs = {k: hashlib.sha256() for k in ("nnz", "label", "index", "value")}
+    rows = 0
+    parser.before_first()
+    while parser.next():
+        b = parser.value()
+        hs["nnz"].update(
+            np.diff(np.asarray(b.offset)).astype("<i8").tobytes())
+        hs["label"].update(np.ascontiguousarray(b.label).tobytes())
+        hs["index"].update(
+            np.ascontiguousarray(b.index).astype("<u4").tobytes())
+        hs["value"].update(np.ascontiguousarray(b.value).tobytes())
+        rows += b.size
+    if hasattr(parser, "destroy"):
+        parser.destroy()
+    return {k: h.hexdigest() for k, h in hs.items()}, rows
+
+
+def _have_native():
+    from dmlc_tpu import native
+    return native.native_available()
+
+
+class TestImagePayload:
+    def test_round_trip(self):
+        rng = np.random.default_rng(1)
+        px = rng.integers(0, 256, (5, 7, 3), dtype=np.uint8)
+        label, got = decode_image_record(encode_image_record(2.5, px))
+        assert label == np.float32(2.5)
+        np.testing.assert_array_equal(got, px)
+
+    def test_grayscale_gains_channel_axis(self):
+        px = np.arange(12, dtype=np.uint8).reshape(3, 4)
+        _, got = decode_image_record(encode_image_record(0.0, px))
+        assert got.shape == (3, 4, 1)
+        np.testing.assert_array_equal(got.reshape(3, 4), px)
+
+    def test_strict_length_contract(self):
+        payload = encode_image_record(1.0, np.zeros((2, 2, 3), np.uint8))
+        with pytest.raises(DMLCError, match="disagrees"):
+            decode_image_record(payload[:-1])
+        with pytest.raises(DMLCError, match="shorter"):
+            decode_image_record(payload[:10])
+        # shape lies: bump the declared width
+        bad = bytearray(payload)
+        bad[4:8] = struct.pack("<I", 5)
+        with pytest.raises(DMLCError, match="disagrees"):
+            decode_image_record(bytes(bad))
+
+    def test_magic_bits_escape_and_stitch(self, tmp_path):
+        p = tmp_path / "m.rec"
+        expect, escaped = _write_images(p, records=40, magic_every=2)
+        assert escaped > 0
+        from dmlc_tpu.data.parser import Parser
+        parser = Parser.create(str(p), 0, 1, format="recordio_image",
+                               engine="python")
+        rows = []
+        for b in parser:
+            for r in range(b.size):
+                lo, hi = b.offset[r], b.offset[r + 1]
+                rows.append((b.label[r], b.value[lo:hi]))
+        assert len(rows) == len(expect)
+        for (lab, vals), (elab, epx) in zip(rows, expect):
+            assert lab == elab
+            np.testing.assert_array_equal(
+                vals, epx.reshape(-1).astype(np.float32))
+
+
+class TestGoldenParser:
+    def test_decode_matches_writer(self, tmp_path):
+        p = tmp_path / "g.rec"
+        expect, _ = _write_images(p, records=60, ragged=True)
+        from dmlc_tpu.data.parser import Parser
+        parser = Parser.create(str(p), 0, 1, format="recordio_image",
+                               engine="python")
+        seen = 0
+        for b in parser:
+            for r in range(b.size):
+                lo, hi = b.offset[r], b.offset[r + 1]
+                elab, epx = expect[seen]
+                assert b.label[r] == elab
+                np.testing.assert_array_equal(
+                    b.value[lo:hi], epx.reshape(-1).astype(np.float32))
+                np.testing.assert_array_equal(
+                    b.index[lo:hi], np.arange(hi - lo, dtype=np.uint32))
+                seen += 1
+        assert seen == 60
+
+    def test_split_type_guard(self, tmp_path):
+        from dmlc_tpu.data.parser import Parser
+        p = tmp_path / "g.rec"
+        _write_images(p, records=5)
+        with pytest.raises(DMLCError, match="split_type"):
+            Parser.create(str(p), 0, 1, format="recordio_image",
+                          engine="python", split_type="text")
+
+
+@pytest.mark.skipif(not _have_native(), reason="native engine not built")
+class TestNativeParity:
+    def test_byte_parity(self, tmp_path):
+        from dmlc_tpu.data.parser import Parser
+        p = tmp_path / "n.rec"
+        _write_images(p, records=300, ragged=True)
+        g, grows = _stream_content(
+            Parser.create(str(p), 0, 1, format="recordio_image",
+                          engine="python"))
+        n, nrows = _stream_content(
+            Parser.create(str(p), 0, 1, format="recordio_image",
+                          engine="native"))
+        assert grows == nrows == 300
+        assert g == n
+
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_sharded_byte_parity(self, tmp_path, shards):
+        from dmlc_tpu.data.parser import Parser
+        p = tmp_path / "s.rec"
+        _write_images(p, records=240)
+        one, _ = _stream_content(
+            Parser.create(str(p), 0, 1, format="recordio_image",
+                          engine="native"))
+        sh, rows = _stream_content(
+            Parser.create(str(p), 0, 1, format="recordio_image",
+                          engine="native", shards=shards))
+        assert rows == 240
+        assert sh == one
+
+    def test_part_split_parity(self, tmp_path):
+        from dmlc_tpu.data.parser import Parser
+        p = tmp_path / "p.rec"
+        _write_images(p, records=200)
+        for k in range(3):
+            g, grows = _stream_content(
+                Parser.create(str(p), k, 3, format="recordio_image",
+                              engine="python"))
+            n, nrows = _stream_content(
+                Parser.create(str(p), k, 3, format="recordio_image",
+                              engine="native"))
+            assert g == n and grows == nrows
+
+    def test_corrupt_payload_rejected_both_engines(self, tmp_path):
+        from dmlc_tpu.data.parser import Parser
+        from dmlc_tpu.io.recordio import RecordIOWriter
+        p = tmp_path / "bad.rec"
+        with create_stream(str(p), "w") as s:
+            w = RecordIOWriter(s)
+            w.write_record(encode_image_record(
+                1.0, np.zeros((4, 4, 3), np.uint8)))
+            # a payload whose declared shape disagrees with its length
+            good = encode_image_record(0.0, np.zeros((2, 2, 1), np.uint8))
+            w.write_record(good[:-2])
+        for engine in ("python", "native"):
+            parser = Parser.create(str(p), 0, 1,
+                                   format="recordio_image",
+                                   engine=engine)
+            with pytest.raises(DMLCError,
+                               match="disagrees|shorter"):
+                for _ in parser:
+                    pass
+            if hasattr(parser, "destroy"):
+                parser.destroy()
+
+    def test_leak_probe_outstanding_zero(self, tmp_path):
+        from dmlc_tpu.data.parser import Parser
+        p = tmp_path / "l.rec"
+        _write_images(p, records=60)
+        parser = Parser.create(str(p), 0, 1, format="recordio_image",
+                               engine="native")
+        for _ in range(2):
+            parser.before_first()
+            while parser.next():
+                pass
+            assert parser.outstanding() == 0
+        parser.destroy()
+
+
+@pytest.mark.skipif(not _have_native(), reason="native engine not built")
+class TestPaddedPipeline:
+    def test_decoded_batches_fuse_and_match(self, tmp_path):
+        """The config-3 acceptance shape: uniform-shape .rec -> padded
+        device-layout batches, python-fused and native-padded
+        byte-identical; the native lowering must actually fuse."""
+        from dmlc_tpu.pipeline import Pipeline
+        p = tmp_path / "pipe.rec"
+        h, w, c = 6, 8, 3
+        _write_images(p, records=150, shape=(h, w, c))
+        rows = 32
+        nnz = rows * h * w * c
+
+        def run(engine):
+            built = (Pipeline.from_uri(str(p))
+                     .parse(format="recordio_image", engine=engine)
+                     .batch(rows, pad=True, nnz_bucket=nnz)
+                     .build())
+            hh = hashlib.sha256()
+            shapes = []
+            for b in built:
+                for k in sorted(b):
+                    hh.update(k.encode())
+                    hh.update(np.ascontiguousarray(b[k]).tobytes())
+                shapes.append(int(b["num_rows"]))
+            snap = built.stats()
+            ap = next((x["assembly_path"] for s in snap["stages"]
+                       if (x := s.get("extra") or {}).get(
+                           "assembly_path")), None)
+            built.close()
+            return hh.hexdigest(), shapes, ap
+
+        hg, sg, apg = run("python")
+        hn, sn, apn = run("native")
+        assert apg == "python-fused" and apn == "native-padded"
+        assert sg == sn
+        assert hg == hn
+        # decoded batches: the padded value block reshapes to images
+        built = (Pipeline.from_uri(str(p))
+                 .parse(format="recordio_image", engine="native")
+                 .batch(rows, pad=True, nnz_bucket=nnz).build())
+        batch = next(iter(built))
+        imgs = np.asarray(batch["value"]).reshape(rows, h, w, c)
+        assert imgs.dtype == np.float32
+        assert imgs.min() >= 0.0 and imgs.max() <= 255.0
+        built.close()

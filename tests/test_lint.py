@@ -328,6 +328,31 @@ class TestLintGate:
                               "policy.py")
         assert lint.codec_lint([policy]) == []
 
+    def test_arrow_gate_clean(self):
+        # pyarrow imports in dmlc_tpu/ confined to the parquet golden
+        # and the bench corpus makers (the native lane must never
+        # silently lean on pyarrow)
+        findings = lint.arrow_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_arrow_gate_catches_planted_violation(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe12.py")
+        with open(bad, "w") as f:
+            f.write("import pyarrow\nfrom pyarrow import parquet\n")
+        try:
+            findings = lint.arrow_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 2, "\n".join(findings)
+        assert all("parquet_parser.py" in f for f in findings)
+
+    def test_arrow_gate_exempts_golden_and_bench(self):
+        golden = os.path.join(lint.REPO, "dmlc_tpu", "data",
+                              "parquet_parser.py")
+        bench = os.path.join(lint.REPO, "dmlc_tpu", "bench_suite.py")
+        assert lint.arrow_lint([golden]) == []
+        assert lint.arrow_lint([bench]) == []
+
     def test_profile_gate_clean(self):
         # sys._current_frames walks and cProfile/profile/pstats
         # imports confined to obs/profile.py

@@ -512,7 +512,8 @@ class TestTruncatedFile:
         paths = (C.c_char_p * 1)(str(p).encode())
         sizes = (C.c_int64 * 1)(10_000)  # lie: promise more bytes
         h = lib.dtp_parser_create(paths, sizes, 1, 0, 1, b"libsvm", 1,
-                                  1 << 20, 0, -1, -1, b",", 0)
+                                  1 << 20, 0, -1, -1, b",", 0, None,
+                                  None)
         assert h
         from dmlc_tpu.native.bindings import NativeLibSVMParser
         parser = NativeLibSVMParser.__new__(NativeLibSVMParser)
@@ -848,6 +849,15 @@ def _gcc_flags():
     return flags
 
 
+def _link_flags():
+    """Trailing link/feature flags every engine-including binary needs:
+    the zlib decision (ABI 8 parquet GZIP pages) is build.zlib_flags(),
+    shared with the .so build so test binaries and the library always
+    agree."""
+    from dmlc_tpu.native.build import zlib_flags
+    return zlib_flags()
+
+
 _have_gxx = __import__("shutil").which("g++") is not None
 
 
@@ -1001,7 +1011,7 @@ class TestCppUnittests:
                            "src", source_name)
         exe = str(tmp_path / source_name.replace(".cc", ""))
         build = subprocess.run(
-            ["g++"] + _gcc_flags() + [src, "-o", exe],
+            ["g++"] + _gcc_flags() + [src, "-o", exe] + _link_flags(),
             capture_output=True, text=True, timeout=300)
         assert build.returncode == 0, build.stderr[-2000:]
         run = subprocess.run([exe, *argv], capture_output=True, text=True,
@@ -1041,7 +1051,7 @@ class TestASANFuzz:
         build = subprocess.run(
             ["g++", "-fsanitize=address,undefined",
              "-fno-sanitize-recover=all", "-O1", "-g", "-std=c++17",
-             "-pthread", src, "-o", exe],
+             "-pthread", src, "-o", exe] + _link_flags(),
             capture_output=True, text=True, timeout=300)
         if build.returncode != 0 and "asan" in build.stderr.lower():
             pytest.skip("libasan not available on this toolchain")
@@ -1069,7 +1079,7 @@ class TestTSAN:
         exe = str(tmp_path / "engine_stress_tsan")
         build = subprocess.run(
             ["g++", "-fsanitize=thread", "-O1", "-g", "-std=c++17",
-             "-pthread", src, "-o", exe],
+             "-pthread", src, "-o", exe] + _link_flags(),
             capture_output=True, text=True, timeout=300)
         if build.returncode != 0 and "tsan" in build.stderr.lower():
             pytest.skip("libtsan not available on this toolchain")

@@ -1,0 +1,439 @@
+"""Native Parquet page decode (ABI 8): byte parity with the pyarrow
+golden across the supported matrix (i32/i64/f32/f64, def-level nulls,
+PLAIN + RLE-dictionary, UNCOMPRESSED + GZIP, multi-page chunks),
+row-group-aligned part splits and shards, the fused padded pipeline,
+loud fallback for everything outside the matrix, and the corruption
+contract."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+from dmlc_tpu.data.parser import Parser  # noqa: E402
+from dmlc_tpu.data.parquet_parser import ParquetParser  # noqa: E402
+from dmlc_tpu.data.rowblock import RowBlockContainer  # noqa: E402
+from dmlc_tpu.utils.logging import DMLCError  # noqa: E402
+
+
+def _have_native():
+    from dmlc_tpu import native
+    return native.native_available()
+
+
+def _with_nulls(rng, arr, frac=0.15):
+    m = rng.rand(len(arr)) < frac
+    a = arr.astype(object)
+    a[m] = None
+    return pa.array(list(a), type=pa.from_numpy_dtype(arr.dtype))
+
+
+def _mixed_table(rng, n=3000, nulls=True):
+    wrap = (lambda a: _with_nulls(rng, a)) if nulls \
+        else (lambda a: pa.array(a))
+    return pa.table({
+        "label": pa.array(rng.rand(n).astype(np.float32)),
+        "f0": wrap(rng.rand(n).astype(np.float32)),
+        "f1": wrap(rng.randn(n).astype(np.float64)),
+        "i0": wrap(rng.randint(-1000, 1000, n).astype(np.int32)),
+        # big int64s pin the null-dependent double-rounding contract
+        "i1": wrap((rng.randint(0, 2 ** 62, n) - 2 ** 61)
+                   .astype(np.int64)),
+        "w": pa.array(rng.rand(n).astype(np.float32)),
+    })
+
+
+def _drain(path, engine, fmt="parquet_native", k=0, n=1, **kw):
+    c = RowBlockContainer(np.uint32)
+    p = Parser.create(path, k, n, format=fmt, engine=engine,
+                      label_column="label", **kw)
+    for b in p:
+        c.push_block(b)
+    if hasattr(p, "destroy"):
+        p.destroy()
+    return c.get_block()
+
+
+def _block_eq(a, b):
+    """Bit-exact block comparison (values/labels compared as raw bits
+    so NaNs participate)."""
+    return (np.array_equal(a.offset, b.offset)
+            and np.array_equal(a.label.view(np.uint32),
+                               b.label.view(np.uint32))
+            and np.array_equal(a.index, b.index)
+            and np.array_equal(a.value.view(np.uint32),
+                               b.value.view(np.uint32)))
+
+
+def _stream_hash(parser):
+    h = hashlib.sha256()
+    rows = 0
+    parser.before_first()
+    while parser.next():
+        b = parser.value()
+        h.update(np.diff(np.asarray(b.offset)).astype("<i8").tobytes())
+        h.update(np.ascontiguousarray(b.label).tobytes())
+        h.update(np.ascontiguousarray(b.index).astype("<u4").tobytes())
+        h.update(np.ascontiguousarray(b.value).tobytes())
+        rows += b.size
+    if hasattr(parser, "destroy"):
+        parser.destroy()
+    return h.hexdigest(), rows
+
+
+@pytest.mark.skipif(not _have_native(), reason="native engine not built")
+class TestNativeParity:
+    @pytest.mark.parametrize("compression,use_dict,nulls", [
+        ("NONE", False, False),
+        ("NONE", True, True),
+        ("GZIP", False, True),
+        ("GZIP", True, False),
+    ])
+    def test_byte_parity(self, tmp_path, rng, compression, use_dict,
+                         nulls):
+        t = _mixed_table(rng, nulls=nulls)
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(t, path, row_group_size=700,
+                       compression=compression, use_dictionary=use_dict)
+        g = _drain(path, "python")
+        n = _drain(path, "native")
+        assert g.size == n.size == 3000
+        assert _block_eq(g, n)
+
+    def test_multi_page_chunks(self, tmp_path, rng):
+        t = _mixed_table(rng)
+        path = str(tmp_path / "mp.parquet")
+        # tiny data_page_size: several V1 pages per column chunk
+        pq.write_table(t, path, row_group_size=1500,
+                       compression="GZIP", data_page_size=2048)
+        assert _block_eq(_drain(path, "python"), _drain(path, "native"))
+
+    def test_weight_column(self, tmp_path, rng):
+        t = _mixed_table(rng, n=500, nulls=False)
+        path = str(tmp_path / "w.parquet")
+        pq.write_table(t, path, compression="NONE")
+        g = _drain(path, "python", weight_column="w")
+        n = _drain(path, "native", weight_column="w")
+        assert g.weight is not None and n.weight is not None
+        assert np.array_equal(g.weight, n.weight)
+        assert _block_eq(g, n)
+
+    def test_part_split_parity_and_coverage(self, tmp_path, rng):
+        t = _mixed_table(rng)
+        path = str(tmp_path / "p.parquet")
+        pq.write_table(t, path, row_group_size=400, compression="NONE")
+        whole = _drain(path, "python")
+        rows = 0
+        labels = []
+        for k in range(3):
+            g = _drain(path, "python", k=k, n=3)
+            n = _drain(path, "native", k=k, n=3)
+            assert _block_eq(g, n)
+            rows += g.size
+            labels.append(g.label)
+        assert rows == whole.size
+        # contiguous ranges: parts concatenate in FILE order
+        np.testing.assert_array_equal(np.concatenate(labels),
+                                      whole.label)
+
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_sharded_byte_identical(self, tmp_path, rng, shards):
+        t = _mixed_table(rng)
+        path = str(tmp_path / "s.parquet")
+        pq.write_table(t, path, row_group_size=300, compression="NONE")
+        one, n1 = _stream_hash(
+            Parser.create(path, 0, 1, format="parquet_native",
+                          engine="native", label_column="label"))
+        sh, ns = _stream_hash(
+            Parser.create(path, 0, 1, format="parquet_native",
+                          engine="native", label_column="label",
+                          shards=shards))
+        assert ns == n1 == 3000
+        assert sh == one
+
+    def test_directory_of_part_files(self, tmp_path, rng):
+        d = tmp_path / "ds"
+        d.mkdir()
+        for k in range(3):
+            t = _mixed_table(rng, n=400)
+            pq.write_table(t, str(d / f"part-{k}.parquet"),
+                           row_group_size=150, compression="NONE")
+        g = _drain(str(d), "python")
+        n = _drain(str(d), "native")
+        assert g.size == n.size == 1200
+        assert _block_eq(g, n)
+
+    def test_buffered_fallback_parity(self, tmp_path, rng,
+                                      monkeypatch):
+        """DMLC_TPU_NO_MMAP=1 routes row-group chunks through the
+        buffered reader (fread of the span) — byte-identical to the
+        mmap-view path."""
+        path = str(tmp_path / "b.parquet")
+        pq.write_table(_mixed_table(rng, n=1000), path,
+                       row_group_size=250, compression="GZIP")
+        g = _drain(path, "native")
+        monkeypatch.setenv("DMLC_TPU_NO_MMAP", "1")
+        n = _drain(path, "native")
+        assert _block_eq(g, n)
+
+    def test_leak_probe_outstanding_zero(self, tmp_path, rng):
+        path = str(tmp_path / "l.parquet")
+        pq.write_table(_mixed_table(rng, n=800), path,
+                       row_group_size=200, compression="NONE")
+        p = Parser.create(path, 0, 1, format="parquet_native",
+                          engine="native", label_column="label")
+        for _ in range(2):
+            p.before_first()
+            while p.next():
+                pass
+            assert p.outstanding() == 0
+        assert p.bytes_read() > 0
+        p.destroy()
+
+
+@pytest.mark.skipif(not _have_native(), reason="native engine not built")
+class TestFallbackMatrix:
+    """Everything outside the native matrix falls back to the pyarrow
+    golden at CREATE (engine='auto'), loudly under engine='native'."""
+
+    def _simple(self, tmp_path, rng, **write_kw):
+        path = str(tmp_path / "f.parquet")
+        t = pa.table({"label": pa.array(rng.rand(50).astype(np.float32)),
+                      "f0": pa.array(rng.rand(50).astype(np.float32))})
+        pq.write_table(t, path, **write_kw)
+        return path
+
+    def test_snappy_falls_back(self, tmp_path, rng):
+        path = self._simple(tmp_path, rng, compression="SNAPPY")
+        p = Parser.create(path, 0, 1, format="parquet_native",
+                          engine="auto", label_column="label")
+        assert isinstance(p, ParquetParser)  # the pyarrow golden
+        p.destroy()
+        with pytest.raises(DMLCError, match="codec|SNAPPY|snappy|1"):
+            Parser.create(path, 0, 1, format="parquet_native",
+                          engine="native", label_column="label")
+
+    def test_v2_pages_fall_back(self, tmp_path, rng):
+        path = self._simple(tmp_path, rng, compression="NONE",
+                            data_page_version="2.0")
+        p = Parser.create(path, 0, 1, format="parquet_native",
+                          engine="auto", label_column="label")
+        assert isinstance(p, ParquetParser)
+        p.destroy()
+
+    def test_string_column_falls_back(self, tmp_path, rng):
+        path = str(tmp_path / "str.parquet")
+        t = pa.table({"label": pa.array([0.0, 1.0]),
+                      "name": pa.array(["a", "b"])})
+        pq.write_table(t, path, compression="NONE")
+        p = Parser.create(path, 0, 1, format="parquet_native",
+                          engine="auto", label_column="label")
+        assert isinstance(p, ParquetParser)
+        p.destroy()
+        with pytest.raises(DMLCError, match="physical type"):
+            Parser.create(path, 0, 1, format="parquet_native",
+                          engine="native", label_column="label")
+
+    def test_sparse_falls_back(self, tmp_path, rng):
+        path = self._simple(tmp_path, rng, compression="NONE")
+        p = Parser.create(path, 0, 1, format="parquet_native",
+                          engine="auto", label_column="label",
+                          sparse=True)
+        assert isinstance(p, ParquetParser)
+        p.destroy()
+
+    def test_missing_label_column_errors(self, tmp_path, rng):
+        path = self._simple(tmp_path, rng, compression="NONE")
+        with pytest.raises(DMLCError, match="not in the schema"):
+            Parser.create(path, 0, 1, format="parquet_native",
+                          engine="native", label_column="nope")
+
+    def test_v2_pages_loud_under_native(self, tmp_path, rng):
+        """V2 pages pass footer parse (page type shows up at decode):
+        the error is loud AT DECODE under engine='native'."""
+        path = self._simple(tmp_path, rng, compression="NONE",
+                            data_page_version="2.0")
+        # engine="native" may fail at create (probe) or first decode;
+        # either way it must NAME the V2 gap, never emit wrong bytes
+        try:
+            p = Parser.create(path, 0, 1, format="parquet_native",
+                              engine="native", label_column="label")
+        except DMLCError as e:
+            assert "V2" in str(e)
+            return
+        with pytest.raises(DMLCError, match="V2"):
+            for _ in p:
+                pass
+        p.destroy()
+
+
+@pytest.mark.skipif(not _have_native(), reason="native engine not built")
+class TestCorruption:
+    def test_truncated_file_rejected(self, tmp_path, rng):
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(_mixed_table(rng, n=200), path,
+                       compression="NONE")
+        data = open(path, "rb").read()
+        bad = str(tmp_path / "bad.parquet")
+        with open(bad, "wb") as f:
+            f.write(data[:len(data) // 2])
+        with pytest.raises(DMLCError):
+            Parser.create(bad, 0, 1, format="parquet_native",
+                          engine="native", label_column="label")
+
+    def test_corrupt_page_run_rejected(self, tmp_path, rng):
+        """Zeroing a column chunk's page bytes breaks the page-header
+        walk: the decode must raise, never emit shifted values."""
+        path = str(tmp_path / "c.parquet")
+        pq.write_table(_mixed_table(rng, n=500, nulls=False), path,
+                       row_group_size=500, compression="NONE",
+                       use_dictionary=False)
+        md = pq.ParquetFile(path).metadata.row_group(0).column(1)
+        data = bytearray(open(path, "rb").read())
+        off = md.data_page_offset
+        data[off:off + 16] = b"\xff" * 16
+        bad = str(tmp_path / "cbad.parquet")
+        with open(bad, "wb") as f:
+            f.write(bytes(data))
+        p = Parser.create(bad, 0, 1, format="parquet_native",
+                          engine="native", label_column="label")
+        with pytest.raises(DMLCError):
+            for _ in p:
+                pass
+        p.destroy()
+
+
+@pytest.mark.skipif(not _have_native(), reason="native engine not built")
+class TestPaddedPipeline:
+    def test_fused_padded_parity(self, tmp_path, rng):
+        from dmlc_tpu.pipeline import Pipeline
+        path = str(tmp_path / "pipe.parquet")
+        n = 2000
+        t = pa.table({
+            "label": pa.array(rng.rand(n).astype(np.float32)),
+            **{f"f{i}": _with_nulls(rng, rng.rand(n).astype(np.float32))
+               for i in range(6)}})
+        pq.write_table(t, path, row_group_size=300, compression="GZIP")
+        rows = 128
+        nnz = rows * 6
+
+        def run(engine):
+            built = (Pipeline.from_uri(path)
+                     .parse(format="parquet_native", engine=engine,
+                            label_column="label")
+                     .batch(rows, pad=True, nnz_bucket=nnz)
+                     .build())
+            h = hashlib.sha256()
+            nb = 0
+            for b in built:
+                for k in sorted(b):
+                    h.update(k.encode())
+                    h.update(np.ascontiguousarray(b[k]).tobytes())
+                nb += 1
+            snap = built.stats()
+            ap = next((x["assembly_path"] for s in snap["stages"]
+                       if (x := s.get("extra") or {}).get(
+                           "assembly_path")), None)
+            built.close()
+            return h.hexdigest(), nb, ap
+
+        hg, ng, apg = run("python")
+        hn, nn, apn = run("native")
+        assert apg == "python-fused" and apn == "native-padded"
+        assert (hg, ng) == (hn, nn)
+
+    def test_sharded_padded_gang(self, tmp_path, rng):
+        """shards=N under batch(pad=True): the ABI-6 gang cuts padded
+        batches across the row-group-aligned sub-parsers, identical to
+        the 1-parser padded stream."""
+        from dmlc_tpu.pipeline import Pipeline
+        path = str(tmp_path / "gang.parquet")
+        n = 2400
+        t = pa.table({
+            "label": pa.array(rng.rand(n).astype(np.float32)),
+            **{f"f{i}": pa.array(rng.rand(n).astype(np.float32))
+               for i in range(5)}})
+        pq.write_table(t, path, row_group_size=200, compression="NONE")
+
+        def run(shards):
+            kw = {"shards": shards} if shards else {}
+            built = (Pipeline.from_uri(path)
+                     .parse(format="parquet_native", engine="native",
+                            label_column="label", **kw)
+                     .batch(100, pad=True, nnz_bucket=500).build())
+            h = hashlib.sha256()
+            for b in built:
+                for k in sorted(b):
+                    h.update(k.encode())
+                    h.update(np.ascontiguousarray(b[k]).tobytes())
+            snap = built.stats()
+            ap = next((x["assembly_path"] for s in snap["stages"]
+                       if (x := s.get("extra") or {}).get(
+                           "assembly_path")), None)
+            built.close()
+            return h.hexdigest(), ap
+
+        h1, ap1 = run(None)
+        h2, ap2 = run(2)
+        assert ap1 == ap2 == "native-padded"
+        assert h1 == h2
+
+
+class TestDecodePathEvidence:
+    """The obs/analyze decode-evidence satellite: the parse stage
+    stamps which decode path ran, and a parse-bound verdict names it
+    with its measured GB/s."""
+
+    def test_stage_stamps_decode_path(self, tmp_path, rng):
+        from dmlc_tpu.pipeline import Pipeline
+        path = str(tmp_path / "d.parquet")
+        t = pa.table({"label": pa.array(rng.rand(300).astype(np.float32)),
+                      "f0": pa.array(rng.rand(300).astype(np.float32))})
+        pq.write_table(t, path, compression="NONE")
+        built = (Pipeline.from_uri(path)
+                 .parse(format="parquet_native", engine="python",
+                        label_column="label")
+                 .batch(64).build())
+        snap = built.run_epoch()
+        built.close()
+        extras = [s.get("extra") or {} for s in snap["stages"]]
+        paths = [x.get("decode_path") for x in extras
+                 if x.get("decode_path")]
+        assert paths == ["pyarrow"]
+        if _have_native():
+            built = (Pipeline.from_uri(path)
+                     .parse(format="parquet_native", engine="native",
+                            label_column="label")
+                     .batch(64).build())
+            snap = built.run_epoch()
+            built.close()
+            extras = [s.get("extra") or {} for s in snap["stages"]]
+            assert [x.get("decode_path") for x in extras
+                    if x.get("decode_path")] == ["native-page"]
+
+    def test_analyze_names_decode_path(self):
+        from dmlc_tpu.obs.analyze import attribute
+        snap = {"wall_s": 10.0, "epoch": 3, "stages": [
+            {"name": "parse", "kind": "parse", "wait_s": 8.0,
+             "bytes": 5_000_000_000,
+             "extra": {"decode_path": "pyarrow",
+                       "bytes_read": 5_000_000_000}},
+            {"name": "batch", "kind": "batch", "wait_s": 0.5},
+        ]}
+        v = attribute(snap)
+        assert v["bound"] == "parse"
+        decode_lines = [e for e in v["evidence"]
+                        if "decode path" in e]
+        assert len(decode_lines) == 1
+        assert "pyarrow" in decode_lines[0]
+        assert "GB/s" in decode_lines[0]
+
+    def test_analyze_decode_line_absent_without_path(self):
+        from dmlc_tpu.obs.analyze import attribute
+        snap = {"wall_s": 10.0, "stages": [
+            {"name": "parse", "kind": "parse", "wait_s": 8.0}]}
+        v = attribute(snap)
+        assert not any("decode path" in e for e in v["evidence"])
