@@ -40,7 +40,9 @@ def main() -> int:
     from dmlc_tpu.obs.serve import serve_if_env
     from dmlc_tpu.obs.timeseries import install_if_env as hist_if_env
     from dmlc_tpu.obs.trace import trace_if_env
+    from dmlc_tpu.pipeline.scheduler import install_if_env as sched_if_env
     serve_if_env()
+    sched_if_env()    # DMLC_TPU_SCHED: multi-tenant scheduler
     hist_if_env()     # before flight: DMLC_TPU_HISTORY_S must win
     install_if_env()
     gang_if_env()     # DMLC_TPU_GANG_POLL_S (rank 0 only): /gang

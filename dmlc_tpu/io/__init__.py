@@ -18,6 +18,7 @@ from dmlc_tpu.io.tpu_fs import (  # registers the tpu:// scheme on import
     TPUFileSystem, TPUSeekStream, recordio_device_batches,
 )
 from dmlc_tpu.io.pagestore import PageStore
+from dmlc_tpu.io.streaming_split import StreamingSplit
 from dmlc_tpu.io import objstore  # registers obj:// + s3:// on import
 
 __all__ = [
@@ -26,5 +27,5 @@ __all__ = [
     "LocalFileSystem", "TemporaryDirectory", "InputSplit",
     "RecordIOWriter", "RecordIOReader", "RecordIOChunkReader", "RECORDIO_MAGIC",
     "TPUFileSystem", "TPUSeekStream", "recordio_device_batches",
-    "PageStore", "objstore",
+    "PageStore", "StreamingSplit", "objstore",
 ]

@@ -991,7 +991,7 @@ class NativeParquetParser(NativeTextParser):
     Emission matches the pyarrow golden's dense path byte for byte
     (data/parquet_parser.py): feature columns in schema order, label/
     weight by name. Anything outside that matrix — nested or byte-array
-    columns, snappy/zstd pages, V2 data pages, ``sparse=True`` — fails
+    columns, zstd pages, V2 data pages, ``sparse=True`` — fails
     create with a NAMED error, so ``engine="auto"`` falls back to the
     pyarrow golden loudly-at-build, never wrongly-at-decode. Row-group-
     aligned ``shards=N`` byte-range partition means sharded parses

@@ -2134,8 +2134,9 @@ void ParseRecIOImageSlice(const char* d, size_t n, CSRArena* a) {
 //     create, so engine="auto" falls back to the pyarrow golden)
 //   - def-level null bitmaps (max def level 1; nulls decode to NaN,
 //     the golden's to_numpy()->astype(float32) behavior)
-//   - UNCOMPRESSED + GZIP codecs (zlib — the stdlib-guaranteed pair;
-//     snappy/zstd pages fall back to the golden the same loud way)
+//   - UNCOMPRESSED + SNAPPY (a native raw-format decoder below — the
+//     most common parquet codec needs no library) + GZIP (zlib)
+//     codecs; zstd pages fall back to the golden the same loud way
 //
 // Dense emission matches data/parquet_parser.py's dense path byte for
 // byte: feature columns in schema order, row-major f32 cell values,
@@ -2154,7 +2155,11 @@ enum PqType : int32_t {
   kPqFloat = 4,
   kPqDouble = 5,
 };
-enum PqCodec : int32_t { kPqUncompressed = 0, kPqGzip = 2 };
+enum PqCodec : int32_t {
+  kPqUncompressed = 0,
+  kPqSnappy = 1,
+  kPqGzip = 2,
+};
 enum PqEncoding : int32_t {
   kPqPlain = 0,
   kPqPlainDict = 2,
@@ -2205,6 +2210,98 @@ void PqInflate(const char* src, size_t n, char* dst, size_t rawlen) {
       "parquet: GZIP page but the engine was built without zlib "
       "(rebuild with zlib.h available, or write UNCOMPRESSED pages)"};
 #endif
+}
+
+// Raw snappy block decompression — the most common Parquet page codec
+// (parquet-cpp's default), decoded natively with no library
+// dependency. The raw format is small: a varint preamble carrying the
+// uncompressed length, then a tag stream of literals and
+// back-references (copy with 1/2/4-byte little-endian offsets). Same
+// discipline as PqInflate: the output must be EXACTLY rawlen bytes
+// (parquet records the uncompressed page size, and the preamble must
+// agree), every length/offset is checked against both buffers before
+// any byte moves, and overlapping copies run byte-wise (offset <
+// length is the legal RLE encoding, memcpy would tear it) — corrupt
+// input is an EngineError, never an over-read or shifted bytes.
+void SnappyDecompress(const char* src_c, size_t n, char* dst,
+                      size_t rawlen) {
+  const uint8_t* src = (const uint8_t*)src_c;
+  const uint8_t* end = src + n;
+  // preamble: uncompressed length as a varint (<= 32 bits)
+  uint64_t preamble = 0;
+  int shift = 0;
+  while (true) {
+    if (src >= end)
+      throw EngineError{"parquet: truncated snappy preamble"};
+    uint8_t b = *src++;
+    preamble |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+    if (shift > 31)
+      throw EngineError{"parquet: snappy preamble varint overflow"};
+  }
+  if (preamble != rawlen)
+    throw EngineError{
+        "parquet: snappy preamble says " + std::to_string(preamble) +
+        " bytes but the page header says " + std::to_string(rawlen)};
+  size_t out = 0;
+  while (src < end) {
+    uint8_t tag = *src++;
+    if ((tag & 3) == 0) {  // literal
+      size_t len = (size_t)(tag >> 2) + 1;
+      if (len > 60) {
+        size_t extra = len - 60;  // 1..4 length bytes follow
+        if ((size_t)(end - src) < extra)
+          throw EngineError{"parquet: truncated snappy literal length"};
+        len = 0;
+        for (size_t i = 0; i < extra; ++i)
+          len |= (size_t)src[i] << (8 * i);
+        len += 1;
+        src += extra;
+      }
+      if ((size_t)(end - src) < len)
+        throw EngineError{"parquet: snappy literal overruns the page"};
+      if (rawlen - out < len)
+        throw EngineError{"parquet: snappy output overrun (literal)"};
+      std::memcpy(dst + out, src, len);
+      src += len;
+      out += len;
+      continue;
+    }
+    size_t len, offset;
+    if ((tag & 3) == 1) {  // copy, 11-bit offset
+      if (src >= end)
+        throw EngineError{"parquet: truncated snappy copy-1"};
+      len = ((tag >> 2) & 7) + 4;
+      offset = ((size_t)(tag >> 5) << 8) | *src++;
+    } else if ((tag & 3) == 2) {  // copy, 2-byte offset
+      if ((size_t)(end - src) < 2)
+        throw EngineError{"parquet: truncated snappy copy-2"};
+      len = (size_t)(tag >> 2) + 1;
+      offset = (size_t)src[0] | ((size_t)src[1] << 8);
+      src += 2;
+    } else {  // copy, 4-byte offset
+      if ((size_t)(end - src) < 4)
+        throw EngineError{"parquet: truncated snappy copy-4"};
+      len = (size_t)(tag >> 2) + 1;
+      offset = (size_t)src[0] | ((size_t)src[1] << 8) |
+               ((size_t)src[2] << 16) | ((size_t)src[3] << 24);
+      src += 4;
+    }
+    if (offset == 0 || offset > out)
+      throw EngineError{"parquet: snappy copy offset " +
+                        std::to_string(offset) + " outside the " +
+                        std::to_string(out) + " bytes produced"};
+    if (rawlen - out < len)
+      throw EngineError{"parquet: snappy output overrun (copy)"};
+    // byte-wise on purpose: offset < len (overlap) replicates the
+    // trailing run — the format's RLE idiom
+    for (size_t i = 0; i < len; ++i, ++out) dst[out] = dst[out - offset];
+  }
+  if (out != rawlen)
+    throw EngineError{
+        "parquet: snappy stream produced " + std::to_string(out) +
+        " of " + std::to_string(rawlen) + " bytes"};
 }
 
 // Bounded thrift-compact reader: every read is checked against the
@@ -2445,14 +2542,16 @@ PqColumnMeta PqParseColumnChunk(TCReader& r, const PqLeaf& leaf) {
         }
         case 4:
           cm.codec = (int32_t)r.zigzag();
-          if (cm.codec != kPqUncompressed && cm.codec != kPqGzip)
+          if (cm.codec != kPqUncompressed && cm.codec != kPqSnappy &&
+              cm.codec != kPqGzip)
             // reject AT CREATE so engine="auto" falls back to the
-            // pyarrow golden before any decode runs
+            // pyarrow golden before any decode runs (zstd/brotli/lz4
+            // stay out of the matrix)
             throw EngineError{
                 "parquet: compression codec " +
                 std::to_string(cm.codec) + " on column '" + leaf.name +
-                "' is not decodable natively (UNCOMPRESSED and GZIP "
-                "are)"};
+                "' is not decodable natively (UNCOMPRESSED, SNAPPY "
+                "and GZIP are)"};
           return true;
         case 5:
           cm.num_values = r.zigzag();
@@ -2908,18 +3007,23 @@ void PqDecodeColumn(const PqLeaf& leaf, const PqColumnMeta& cm,
             "parquet: UNCOMPRESSED page with comp != unc size"};
       raw = (const uint8_t*)body;
       rawlen = (size_t)ph.unc_size;
-    } else if (cm.codec == kPqGzip) {
+    } else if (cm.codec == kPqSnappy || cm.codec == kPqGzip) {
       if (ph.unc_size > (64ll << 20))
         throw EngineError{"parquet: page inflates past 64 MB"};
       S->raw.resize((size_t)ph.unc_size);
-      PqInflate(body, (size_t)ph.comp_size, (char*)S->raw.data(),
-                (size_t)ph.unc_size);
+      if (cm.codec == kPqSnappy)
+        SnappyDecompress(body, (size_t)ph.comp_size,
+                         (char*)S->raw.data(), (size_t)ph.unc_size);
+      else
+        PqInflate(body, (size_t)ph.comp_size, (char*)S->raw.data(),
+                  (size_t)ph.unc_size);
       raw = S->raw.data();
       rawlen = (size_t)ph.unc_size;
     } else {
       throw EngineError{
           "parquet: compression codec " + std::to_string(cm.codec) +
-          " is not decodable natively (UNCOMPRESSED and GZIP are)"};
+          " is not decodable natively (UNCOMPRESSED, SNAPPY and GZIP "
+          "are)"};
     }
     if (ph.type == kPqDictPage) {
       if (have_dict)

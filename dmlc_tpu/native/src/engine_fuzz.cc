@@ -277,6 +277,8 @@ std::string make_parquet_file() {
   pq_add_dict_data_page(&f0, idx, defs, 3);
   PqTestColumn f1;
   f1.name = "f1";
+  f1.codec = 1;  // SNAPPY: page mutations drive the raw snappy
+  //               decoder's bounds checks under ASAN too
   std::vector<float> pv;
   std::vector<uint32_t> d2;
   for (int i = 0; i < 24; ++i) {

@@ -682,6 +682,143 @@ static void test_parquet_gzip_pages() {
 }
 #endif
 
+// raw snappy decode: hand-crafted vectors drive every element kind
+// (short/extended literals, copy-1/2/4, the overlapping-copy RLE
+// idiom) and the rejection matrix (bad offsets, output overruns,
+// truncated elements, preamble disagreement) — the page-level test
+// below only exercises the all-literal writer
+static void test_snappy_raw() {
+  auto dec = [](const std::string& s, size_t rawlen) {
+    std::string out(rawlen, '\0');
+    SnappyDecompress(s.data(), s.size(), out.data(), rawlen);
+    return out;
+  };
+  auto rejects = [&](const std::string& s, size_t rawlen) {
+    bool threw = false;
+    try {
+      dec(s, rawlen);
+    } catch (const EngineError&) {
+      threw = true;
+    }
+    CHECK_TRUE(threw);
+  };
+  // short literal: preamble 5, tag (5-1)<<2, "hello"
+  CHECK_TRUE(dec(std::string("\x05\x10hello", 7), 5) == "hello");
+  // extended literal (1 length byte): 61 'a's
+  {
+    std::string s;
+    s.push_back(61);                  // preamble
+    s.push_back((char)(60 << 2));     // literal, 1 extra length byte
+    s.push_back(60);                  // len-1
+    s.append(61, 'a');
+    CHECK_TRUE(dec(s, 61) == std::string(61, 'a'));
+  }
+  // copy-1 (11-bit offset): "abcd" then copy len 4 offset 4 -> abcdabcd
+  {
+    std::string s("\x08\x0c" "abcd", 6);
+    s.push_back(1);      // tag: type 1, len 4-4=0 -> 4, offset hi 0
+    s.push_back(4);      // offset lo
+    CHECK_TRUE(dec(s, 8) == "abcdabcd");
+  }
+  // copy-2 with OVERLAP (offset 1 < len 4): 'x' -> 'xxxxx' (RLE idiom)
+  {
+    std::string s("\x05\x00x", 3);
+    s.push_back((char)(((4 - 1) << 2) | 2));  // type 2, len 4
+    s.push_back(1);
+    s.push_back(0);      // offset 1 (LE)
+    CHECK_TRUE(dec(s, 5) == "xxxxx");
+  }
+  // copy-4: same bytes, 4-byte offset
+  {
+    std::string s("\x08\x0c" "abcd", 6);
+    s.push_back((char)(((4 - 1) << 2) | 3));  // type 3, len 4
+    s.push_back(4);
+    s.push_back(0);
+    s.push_back(0);
+    s.push_back(0);
+    CHECK_TRUE(dec(s, 8) == "abcdabcd");
+  }
+  // round-trip the all-literal writer over binary bytes
+  {
+    std::string raw;
+    for (int i = 0; i < 700; ++i) raw.push_back((char)(i * 37));
+    CHECK_TRUE(dec(pq_snappy_compress(raw), raw.size()) == raw);
+  }
+  rejects(std::string("\x05\x10hell", 6), 5);   // literal overruns in
+  rejects(std::string("\x03\x10hello", 7), 3);  // output overrun
+  rejects(std::string("\x06\x10hello", 7), 6);  // short output
+  rejects(std::string("\x05\x10hello", 7), 4);  // preamble != rawlen
+  rejects(std::string("\xff", 1), 5);            // truncated preamble
+  {
+    std::string s("\x08\x0c" "abcd", 6);        // copy offset 5 > 4
+    s.push_back(1);
+    s.push_back(5);
+    rejects(s, 8);
+  }
+  {
+    std::string s("\x08\x0c" "abcd", 6);        // offset 0 illegal
+    s.push_back(1);
+    s.push_back(0);
+    rejects(s, 8);
+  }
+  {
+    std::string s("\x08\x0c" "abcd", 6);        // truncated copy-2
+    s.push_back((char)(((4 - 1) << 2) | 2));
+    s.push_back(1);
+    rejects(s, 8);
+  }
+}
+
+// SNAPPY-coded pages through the whole column-chunk walk: plain +
+// def-level nulls + a dictionary page, all codec=1 (no zlib gate —
+// the decoder is library-free)
+static void test_parquet_snappy_pages() {
+  PqTestColumn lab;
+  lab.name = "label";
+  lab.codec = 1;  // SNAPPY
+  pq_add_plain_page(&lab, {3.0f, 4.0f, 5.0f}, {});
+  PqTestColumn f0;
+  f0.name = "f0";
+  f0.codec = 1;
+  pq_add_plain_page(&f0, {1.25f, -1.25f}, {1, 0, 1});
+  std::string file = pq_build_file({lab, f0}, 3);
+  std::string path = write_tmp_file(file, "pq_snappy");
+  ParquetMeta M = pq_meta_of(path);
+  const PqRowGroup& rg = M.files[0].groups[0];
+  CSRArena a;
+  ParseParquetGroupSlice(M, 0, file.data() + rg.span_lo,
+                         (size_t)(rg.span_hi - rg.span_lo), &a);
+  CHECK_EQ_(a.rows(), 3u);
+  CHECK_EQ_(a.label[2], 5.0f);
+  CHECK_EQ_(a.value[0], 1.25f);
+  CHECK_TRUE(std::isnan(a.value[1]));
+  CHECK_EQ_(a.value[2], -1.25f);
+  // dictionary fanout under snappy framing
+  PqTestColumn lab2;
+  lab2.name = "label";
+  lab2.codec = 1;
+  pq_add_plain_page(&lab2, {1.0f, 2.0f}, {});
+  PqTestColumn f1;
+  f1.name = "f0";
+  f1.codec = 1;
+  pq_add_dict_page(&f1, {10.0f, 20.0f});
+  pq_add_dict_data_page(&f1, {1, 0}, {1, 1}, 1);
+  std::string file2 = pq_build_file({lab2, f1}, 2);
+  std::string path2 = write_tmp_file(file2, "pq_snappy_dict");
+  ParquetMeta M2 = pq_meta_of(path2);
+  const PqRowGroup& rg2 = M2.files[0].groups[0];
+  CSRArena b;
+  ParseParquetGroupSlice(M2, 0, file2.data() + rg2.span_lo,
+                         (size_t)(rg2.span_hi - rg2.span_lo), &b);
+  CHECK_EQ_(b.rows(), 2u);
+  CHECK_EQ_(b.value[0], 20.0f);
+  CHECK_EQ_(b.value[1], 10.0f);
+  // truncated/corrupt snappy streams reject via the vector matrix in
+  // test_snappy_raw (raw snappy carries no checksum, so a payload
+  // bit-flip is legal-but-different bytes — same contract as
+  // UNCOMPRESSED pages; framing violations are what must throw)
+}
+
 // corruption must REJECT via EngineError — never shifted values
 static void test_parquet_rejects() {
   PqTestColumn lab;
@@ -856,6 +993,8 @@ int main() {
 #ifdef DTP_HAVE_ZLIB
   test_parquet_gzip_pages();
 #endif
+  test_snappy_raw();
+  test_parquet_snappy_pages();
   test_parquet_rejects();
   test_parquet_abi_end_to_end();
   test_image_decode();

@@ -11,6 +11,7 @@
 #ifndef DMLC_TPU_PARQUET_TEST_UTIL_H_
 #define DMLC_TPU_PARQUET_TEST_UTIL_H_
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -187,6 +188,38 @@ inline std::string pq_maybe_gzip(const std::string& raw, bool gzip) {
 #endif
 }
 
+// all-literal raw snappy encoder: a valid snappy stream needs no
+// back-references — varint(len) preamble + literal elements (the
+// 1-byte extended-length form, <=256-byte runs). The DECODER's copy
+// paths are exercised by hand-crafted vectors in engine_unittest.cc;
+// this writer exists so test files can carry codec=1 column chunks.
+inline std::string pq_snappy_compress(const std::string& raw) {
+  std::string out;
+  uint64_t v = raw.size();
+  while (v >= 0x80) {
+    out.push_back((char)(0x80 | (v & 0x7f)));
+    v >>= 7;
+  }
+  out.push_back((char)v);
+  size_t pos = 0;
+  while (pos < raw.size()) {
+    size_t len = std::min<size_t>(raw.size() - pos, 256);
+    out.push_back((char)(60 << 2));       // literal, 1 length byte
+    out.push_back((char)(len - 1));
+    out.append(raw, pos, len);
+    pos += len;
+  }
+  return out;
+}
+
+// encode one page body per the column's codec (0 raw / 1 snappy /
+// 2 gzip)
+inline std::string pq_encode_page(const std::string& raw,
+                                  int32_t codec) {
+  if (codec == 1) return pq_snappy_compress(raw);
+  return pq_maybe_gzip(raw, codec == 2);
+}
+
 // ------------------------------------------------------- file builder
 
 // one column's page stream, built incrementally
@@ -197,7 +230,7 @@ struct PqTestColumn {
   int64_t num_values = 0;
   int64_t dict_off_rel = -1;   // within the column's page bytes
   std::string pages;           // concatenated header+body bytes
-  int32_t codec = 0;           // 0 uncompressed / 2 gzip
+  int32_t codec = 0;           // 0 uncompressed / 1 snappy / 2 gzip
 };
 
 // append one PLAIN data page; defs empty = all present (still writes
@@ -215,8 +248,7 @@ inline void pq_add_plain_page(PqTestColumn* col,
                                ? pq_rle_run(defs[0], (int64_t)nv, 1)
                                : pq_bitpack(defs, 1));
   body.append((const char*)values.data(), values.size() * 4);
-  bool gz = col->codec == 2;
-  std::string wire = pq_maybe_gzip(body, gz);
+  std::string wire = pq_encode_page(body, col->codec);
   col->pages += pq_data_page_header((int64_t)nv, 0,
                                     (int64_t)body.size(),
                                     (int64_t)wire.size());
@@ -227,8 +259,7 @@ inline void pq_add_plain_page(PqTestColumn* col,
 inline void pq_add_dict_page(PqTestColumn* col,
                              const std::vector<float>& dict) {
   std::string body((const char*)dict.data(), dict.size() * 4);
-  bool gz = col->codec == 2;
-  std::string wire = pq_maybe_gzip(body, gz);
+  std::string wire = pq_encode_page(body, col->codec);
   col->dict_off_rel = (int64_t)col->pages.size();
   col->pages += pq_dict_page_header((int64_t)dict.size(),
                                     (int64_t)body.size(),
@@ -247,8 +278,7 @@ inline void pq_add_dict_data_page(PqTestColumn* col,
   if (col->optional) body += pq_def_section(pq_bitpack(defs, 1));
   body.push_back((char)bw);
   body += pq_bitpack(idx, bw);
-  bool gz = col->codec == 2;
-  std::string wire = pq_maybe_gzip(body, gz);
+  std::string wire = pq_encode_page(body, col->codec);
   col->pages += pq_data_page_header((int64_t)nv, 8,  // RLE_DICTIONARY
                                     (int64_t)body.size(),
                                     (int64_t)wire.size());

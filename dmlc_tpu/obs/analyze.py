@@ -9,10 +9,18 @@ records — ``StageProbe`` wait totals, the device stage's
 ``assemble_s``, pagestore/objstore hit counters, and the credit-gauge
 bands bench.py computes — into one structured verdict:
 
-``{"schema": 3, "epoch": <monotonic>, "verdict_id": "v<epoch>-<digest>",
-"bound": "parse" | "assemble" | "xfer" | "wire" | "credit-limited" |
-"consumer", "band": <credit band>, "confidence": "high" | "medium" |
-"low", "evidence": [...], "hot_frames": [...], "stage_waits": {...}}``
+``{"schema": 4, "epoch": <monotonic>, "verdict_id": "v<epoch>-<digest>",
+"tenant": <label or None>, "bound": "parse" | "assemble" | "xfer" |
+"wire" | "credit-limited" | "consumer", "band": <credit band>,
+"confidence": "high" | "medium" | "low", "evidence": [...],
+"hot_frames": [...], "stage_waits": {...}}``
+
+``tenant`` (schema 4) is the multi-tenant label: a pipeline admitted
+under a :mod:`dmlc_tpu.pipeline.scheduler` tenant stamps its epoch
+snapshots with the tenant name, so the verdict says WHOSE epoch it
+judged — the ``/tenants`` rows cite a per-tenant bound, and the
+controller's ledger records inherit it through the verdict. None for
+untenanted pipelines.
 
 ``epoch``/``verdict_id`` (schema 3) make verdicts citable: the epoch
 is the snapshot's monotonic counter and the id digests what was
@@ -54,13 +62,17 @@ __all__ = ["attribute", "compare", "compare_files", "load_bench",
 # bump when the verdict's top-level shape changes incompatibly
 # (2: hot_frames — sampling-profiler function-level evidence;
 #  3: epoch + verdict_id — the control ledger back-references the
-#  exact verdict that moved a knob)
-ANALYSIS_SCHEMA = 3
+#  exact verdict that moved a knob;
+#  4: tenant — multi-tenant snapshots carry a tenant label, so a
+#  verdict says WHOSE epoch it judged and the /tenants rows can cite
+#  a per-tenant bound; None for untenanted pipelines)
+ANALYSIS_SCHEMA = 4
 
 # the verdict's pinned key set — scripts/lint.py's verdict-schema gate
 # checks every literal verdict dict in the package against this tuple
-VERDICT_KEYS = ("schema", "epoch", "verdict_id", "bound", "band",
-                "confidence", "evidence", "hot_frames", "stage_waits")
+VERDICT_KEYS = ("schema", "epoch", "verdict_id", "tenant", "bound",
+                "band", "confidence", "evidence", "hot_frames",
+                "stage_waits")
 
 BOUNDS = ("parse", "assemble", "xfer", "wire", "credit-limited",
           "consumer")
@@ -387,13 +399,15 @@ def attribute(pipeline_snap: Dict[str, Any],
     # stable id: the monotonic epoch + a digest of what was judged —
     # two verdicts over the same measurements share an id, a ledger
     # record can reference exactly the verdict that moved its knob
+    tenant = pipeline_snap.get("tenant")
     digest = _hashlib.sha256(json.dumps(
-        [epoch, bound, band, stage_waits],
+        [epoch, tenant, bound, band, stage_waits],
         sort_keys=True).encode()).hexdigest()[:10]
     return {
         "schema": ANALYSIS_SCHEMA,
         "epoch": epoch,
         "verdict_id": f"v{epoch}-{digest}",
+        "tenant": tenant,
         "bound": bound,
         "band": band,
         "confidence": confidence,

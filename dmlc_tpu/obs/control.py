@@ -30,7 +30,7 @@ bounded ×2 steps — generalized here with per-family revert budgets):
 
 The observability headline is the **decision ledger**: every decision
 — including "freeze" and "no-op" — is an immutable record
-``{epoch, verdict_id, bound, band, evidence, family, knob, old, new,
+``{epoch, verdict_id, tenant, bound, band, evidence, family, knob, old, new,
 outcome, reverted}`` kept in a byte-budgeted ring on the
 TimeSeriesRing coarsening discipline (old history halves its
 resolution, the newest and oldest decisions always survive), so an
@@ -83,7 +83,8 @@ CONTROL_SCHEMA = 1
 # pins it): the decision, the verdict that caused it, and the measured
 # evidence — immutable once appended (a revert is a NEW record, never
 # an edit)
-RECORD_KEYS = ("epoch", "verdict_id", "bound", "band", "evidence",
+RECORD_KEYS = ("epoch", "verdict_id", "tenant", "bound", "band",
+               "evidence",
                "family", "knob", "old", "new", "outcome", "reverted")
 
 # verdict bound -> the knob family allowed to move. credit-limited and
@@ -364,7 +365,14 @@ class Controller:
                 return token
             ref = weakref.ref(pipe)
             adopted = []
+            # a tenant-admitted pipeline's queue-capacity knobs belong
+            # to the multi-tenant scheduler's budget rebalancer — one
+            # owner per knob (the same rule that stands the autotuner
+            # down when this controller adopts)
+            sched_owned = set(getattr(pipe, "scheduler_owned", ()))
             for knob in pipe.knobs():
+                if knob.name in sched_owned:
+                    continue
                 family = FAMILY_FOR_STAGE_KIND.get(knob.stage)
                 if family is None:
                     continue
@@ -540,6 +548,10 @@ class Controller:
         record = {
             "epoch": verdict.get("epoch"),
             "verdict_id": verdict.get("verdict_id"),
+            # schema-4 verdicts name the tenant whose epoch moved the
+            # knob — the ledger answers "who caused this move", not
+            # just "what evidence" (None for untenanted pipelines)
+            "tenant": verdict.get("tenant"),
             "bound": verdict.get("bound"),
             "band": verdict.get("band"),
             "evidence": list(verdict.get("evidence")

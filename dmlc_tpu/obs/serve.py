@@ -29,6 +29,11 @@ rank serves:
 - ``GET /gang[?seconds=N]`` — the gang aggregator's merged view
   (:mod:`dmlc_tpu.obs.aggregate`, rank 0 / launcher): per-rank series,
   rollups, explicit unreachable-rank gaps;
+- ``GET /tenants`` — the multi-tenant scheduler's per-tenant rows
+  (:mod:`dmlc_tpu.pipeline.scheduler`): budget, live pipelines,
+  credits/deficit, queue share and occupancy, batch p50/p99, streaming
+  watermark, last bound verdict (404 with an enable hint until a
+  scheduler is installed, like ``/history``);
 - ``GET /analyze`` — a bottleneck-attribution verdict
   (:mod:`dmlc_tpu.obs.analyze`) over the last completed pipeline
   epoch's stage stats + the current registry snapshot;
@@ -449,6 +454,18 @@ class _Handler(BaseHTTPRequestHandler):
                     raw = q.get("last", [None])[0]
                     last = int(raw) if raw else None
                     self._send_json(ctl.to_dict(last=last))
+            elif url.path == "/tenants":
+                from dmlc_tpu.pipeline import scheduler as _sched
+                sched = _sched.active()
+                if sched is None:
+                    self._send_json(
+                        {"error": "no pipeline scheduler installed",
+                         "hint": "set DMLC_TPU_SCHED=1 (launch_local"
+                                 "(scheduler=True)) or call "
+                                 "pipeline.scheduler.install()"},
+                        code=404)
+                else:
+                    self._send_json(sched.to_dict())
             elif url.path == "/analyze":
                 verdict = owner.analyze_verdict()
                 if verdict is None:
@@ -491,6 +508,7 @@ class _Handler(BaseHTTPRequestHandler):
                                                "/healthz", "/stacks",
                                                "/trace?seconds=N",
                                                "/history", "/gang",
+                                               "/tenants",
                                                "/analyze",
                                                "/control[?last=N]",
                                                "/profile?seconds=N"

@@ -58,6 +58,8 @@ ENV_PROFILE_HZ = "DMLC_TPU_PROFILE_HZ"    # sampling-profiler rate
 #   (launch_local(profile_hz=...); obs.profile.install_if_env())
 ENV_CONTROL = "DMLC_TPU_CONTROL"          # verdict-driven controller
 #   (launch_local(control=True); obs.control.install_if_env())
+ENV_SCHED = "DMLC_TPU_SCHED"              # multi-tenant scheduler
+#   (launch_local(scheduler=...); pipeline.scheduler.install_if_env())
 # resilience contracts (dmlc_tpu.resilience): launch_local(faults=...)
 # sets DMLC_TPU_FAULTS for every member; the gang supervisor sets
 # DMLC_TPU_ATTEMPT (alias DMLC_NUM_ATTEMPT — the reference's rejoin
@@ -212,6 +214,7 @@ def launch_local(num_workers: int, command: Sequence[str],
                  gang_poll_s: Optional[float] = None,
                  profile_hz: Optional[float] = None,
                  control: Optional[bool] = None,
+                 scheduler=None,
                  restart_policy=None,
                  faults=None) -> List[int]:
     """Run N worker processes on this host (reference: local.py).
@@ -302,6 +305,14 @@ def launch_local(num_workers: int, command: Sequence[str],
     control``, aggregated gang-wide, and attached to flight bundles
     as ``control.json``.
 
+    ``scheduler=True`` (or a ``DMLC_TPU_SCHED`` option string such
+    as ``"quantum=4,queue=48"``) hands every worker the multi-tenant
+    pipeline scheduler contract: workers that call
+    ``pipeline.scheduler.install_if_env()`` share their process's
+    thread/queue budgets across tenants (``Pipeline.build(tenant=...)``)
+    with DRR pull credits, admission control, and per-tenant rows at
+    ``/tenants`` (rendered by ``obsctl tenants``).
+
     Returns the list of exit codes (workers first in task-id order,
     then scheduler, then servers). Raises if any process fails.
     """
@@ -386,6 +397,9 @@ def launch_local(num_workers: int, command: Sequence[str],
             wenv[ENV_PROFILE_HZ] = str(profile_hz)
         if control:
             wenv[ENV_CONTROL] = "1"
+        if scheduler:
+            wenv[ENV_SCHED] = (scheduler if isinstance(scheduler, str)
+                               else "1")
         if ps_root is not None:
             wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
                                 num_servers, "worker", task_id))

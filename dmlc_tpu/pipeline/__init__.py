@@ -10,6 +10,7 @@ depths (``dmlc_tpu.pipeline.autotune``). See docs/pipeline.md.
 
 from dmlc_tpu.pipeline.autotune import Autotuner, Knob
 from dmlc_tpu.pipeline.graph import CompiledPipeline, Pipeline
+from dmlc_tpu.pipeline.scheduler import AdmissionError, PipelineScheduler
 from dmlc_tpu.pipeline.stages import StageSpec
 from dmlc_tpu.pipeline.stats import (
     PIPELINE_STATS_SCHEMA, StageProbe, snapshot,
@@ -18,5 +19,6 @@ from dmlc_tpu.pipeline.stats import (
 __all__ = [
     "Pipeline", "CompiledPipeline", "StageSpec",
     "Autotuner", "Knob",
+    "PipelineScheduler", "AdmissionError",
     "StageProbe", "snapshot", "PIPELINE_STATS_SCHEMA",
 ]

@@ -191,6 +191,18 @@ class _ParseRunner(_RunnerBase):
         depth = p.pop("prefetch_depth", "auto")
         self._auto_depth = depth == "auto"
         kwargs = {k: v for k, v in p.items() if v is not None}
+        self._stream_split = None
+        stream = sp.get("stream")
+        if stream is not None:
+            # streaming source (Pipeline.from_stream): an EOF-less
+            # windowed split injected under the python engine (the
+            # native reader owns its own split, and a growing file has
+            # no frozen byte range for it to own)
+            from dmlc_tpu.io.streaming_split import StreamingSplit
+            kwargs["engine"] = "python"
+            split = StreamingSplit(sp["uri"], **stream)
+            self._stream_split = split
+            kwargs["split_factory"] = lambda: split
         if shuffle is not None:
             # chunk-level shuffled read order lowers to InputSplitShuffle
             # injected under the python engine (the native reader owns
@@ -218,6 +230,18 @@ class _ParseRunner(_RunnerBase):
             sp["uri"], sp["part_index"], sp["num_parts"], format=fmt,
             prefetch_depth=4 if self._auto_depth else int(depth), **kwargs)
         self.owned = not hasattr(self._parser, "detach")
+        if self._stream_split is not None:
+            # formats whose parser ignores split_factory (parquet's
+            # param struct swallows unknown keys) would silently read
+            # the frozen file instead of the stream — refuse (the
+            # shuffle-injection precedent below)
+            if getattr(self._parser, "_split", None) \
+                    is not self._stream_split:
+                raise DMLCError(
+                    f"pipeline: from_stream is not supported by the "
+                    f"{fmt or 'default'} parser (it ignores the "
+                    "injected split); streaming works with record-"
+                    "stream formats (libsvm/csv/libfm)")
         if shuffle is not None:
             # formats whose parser ignores split_factory (parquet's
             # param struct swallows unknown keys) would silently yield
@@ -256,8 +280,14 @@ class _ParseRunner(_RunnerBase):
 
     def finalize_epoch(self) -> None:
         _finalize_parser(self._parser, self.probe)
+        if self._stream_split is not None:
+            # the monotonic watermark rides the stage extras (and the
+            # scheduler's /tenants rows read it live mid-epoch)
+            self.probe.extra["stream"] = self._stream_split.watermark()
 
     def close(self) -> None:
+        if self._stream_split is not None:
+            self._stream_split.stop()
         if hasattr(self._parser, "destroy"):
             self._parser.destroy()
 
@@ -893,9 +923,16 @@ class CompiledPipeline:
     ``stats()``, let the bound autotuner retune depths between epochs."""
 
     def __init__(self, runners: List[_RunnerBase],
-                 autotuner: Optional[Autotuner]):
+                 autotuner: Optional[Autotuner],
+                 tenant: Optional[str] = None):
         self._runners = runners
         self.autotuner = autotuner
+        # multi-tenant contract (pipeline.scheduler): the tenant this
+        # pipeline bills its pulls to, and the queue-capacity knobs
+        # the scheduler owns (the autotuner/controller must not move
+        # them — one owner per knob)
+        self.tenant = tenant
+        self.scheduler_owned: tuple = ()
         self._epoch = 0
         self._last: Optional[Dict[str, Any]] = None
         # one-way hand-off flag: a controller that raised on this
@@ -918,14 +955,44 @@ class CompiledPipeline:
         abandoned epoch leaves the previous snapshot in place."""
         for r in self._runners:
             r.probe.reset()
+        sched = None
+        if self.tenant is not None:
+            from dmlc_tpu.pipeline import scheduler as _sched
+            sched = _sched.active()
         t0 = time.perf_counter()
-        yield from _probed(self._runners[-1])
+        if sched is None:
+            yield from _probed(self._runners[-1])
+        else:
+            # multi-tenant discipline: every delivered batch costs one
+            # pull credit FIRST (a credit-blocked tenant stops pulling
+            # — its bounded queues fill and the throttle propagates up
+            # to its readers), then bills its latency + volume to the
+            # tenant's accounting
+            from dmlc_tpu.pipeline.stats import _item_stats
+            gen = _probed(self._runners[-1])
+            while True:
+                sched.acquire(self.tenant)
+                tb = time.perf_counter()
+                item = next(gen, _END)
+                if item is _END:
+                    break
+                rows, _nnz, nbytes = _item_stats(item)
+                sched.note_batch(self.tenant,
+                                 time.perf_counter() - tb,
+                                 rows=rows, nbytes=nbytes)
+                yield item
         wall = time.perf_counter() - t0
         for r in self._runners:
             r.finalize_epoch()
         self._epoch += 1
         self._last = snapshot([r.probe for r in self._runners], wall,
                               self._epoch, self.knob_values())
+        if self.tenant is not None:
+            # the tenant label rides the snapshot (obs/analyze emits
+            # per-tenant bound verdicts from it; /tenants rows cite it)
+            self._last["tenant"] = self.tenant
+        if sched is not None:
+            sched.note_epoch(self.tenant, self._last)
         # one mover per process: an installed verdict-driven
         # controller (obs.control) adopts this pipeline's knobs and
         # subsumes the blind hill-climber — the bound verdict picks
@@ -1012,11 +1079,26 @@ class CompiledPipeline:
         installs the global recorder for the duration)."""
         return _trace.trace_to(path, capacity)
 
+    def stream_stats(self) -> Optional[Dict[str, Any]]:
+        """Live watermark of a streaming source (None for finite
+        pipelines) — readable MID-epoch, unlike stats()."""
+        src = self._runners[0]
+        split = getattr(src, "_stream_split", None)
+        return split.watermark() if split is not None else None
+
     @property
     def epochs(self) -> int:
         return self._epoch
 
     def close(self) -> None:
+        if self.tenant is not None:
+            try:
+                from dmlc_tpu.pipeline import scheduler as _sched
+                sched = _sched.active()
+                if sched is not None:
+                    sched.release(self)
+            except Exception:  # noqa: BLE001 — teardown must not fail
+                pass
         if self._metrics_key is not None:
             _METRICS.unregister(self._metrics_key)
             self._metrics_key = None
@@ -1051,6 +1133,32 @@ class Pipeline:
                                    part_index=part_index,
                                    num_parts=num_parts,
                                    split_type=split_type),))
+
+    @staticmethod
+    def from_stream(uri: str, *, window_records: Optional[int] = None,
+                    window_s: Optional[float] = None,
+                    poll_interval_s: float = 0.05,
+                    idle_timeout_s: Optional[float] = None,
+                    chunk_size: int = 8 << 20) -> "Pipeline":
+        """Root of a STREAMING pipeline: an EOF-less windowed read of
+        one growing text source (:class:`dmlc_tpu.io.streaming_split.
+        StreamingSplit`). Appended records accumulate into windows
+        closed by ``window_records`` and/or ``window_s``; each window
+        feeds the unchanged parse/batch/to_device machinery, with a
+        monotonic watermark in the parse stage's ``extra["stream"]``.
+        The epoch ends when the split's ``stop()`` is called and the
+        committed bytes are drained, or after ``idle_timeout_s`` with
+        no growth (None = stream forever). Streaming sources are
+        single-part and cannot be shuffled, cached, or sharded (the
+        chain validator rejects those stages)."""
+        return Pipeline((StageSpec(
+            "source", uri=uri, part_index=0, num_parts=1,
+            split_type="text",
+            stream={"window_records": window_records,
+                    "window_s": window_s,
+                    "poll_interval_s": poll_interval_s,
+                    "idle_timeout_s": idle_timeout_s,
+                    "chunk_size": chunk_size}),))
 
     def _with(self, spec: StageSpec) -> "Pipeline":
         return Pipeline(self._stages + (spec,))
@@ -1173,10 +1281,20 @@ class Pipeline:
     # -- compilation
 
     def build(self, autotune: bool = False,
+              tenant: Optional[str] = None,
               **autotune_opts: Any) -> CompiledPipeline:
         """Validate the chain and lower it onto the existing iterator
         machinery. ``autotune=True`` binds an Autotuner over every
-        "auto" depth knob (no-op when the chain has none)."""
+        "auto" depth knob (no-op when the chain has none).
+
+        ``tenant`` admits the compiled pipeline under that tenant of
+        the installed :mod:`dmlc_tpu.pipeline.scheduler` (admission
+        control applies — past the tenant's budget this RAISES
+        AdmissionError or queues, per the tenant's policy). Every
+        delivered batch then costs one scheduler pull credit, volume
+        and latency bill to the tenant, and the scheduler owns the
+        pipeline's queue-capacity knobs (withheld from the autotuner
+        here — one owner per knob)."""
         specs = self._stages
         validate_chain(specs)
         kinds = [s.kind for s in specs]
@@ -1234,12 +1352,32 @@ class Pipeline:
                     spec.params.get("staging", "auto")))
             else:  # pragma: no cover — validate_chain rejects these
                 raise DMLCError(f"pipeline: unexpected stage {spec.kind!r}")
+        sched = None
+        owned: tuple = ()
+        if tenant is not None:
+            from dmlc_tpu.pipeline import scheduler as _sched
+            sched = _sched.active()
+            if sched is None:
+                raise DMLCError(
+                    "pipeline: build(tenant=...) needs an installed "
+                    "scheduler (dmlc_tpu.pipeline.scheduler.install() "
+                    f"or {_sched.ENV_SCHED}=1)")
+            owned = _sched.MANAGED_KNOBS
         tuner = None
         if autotune:
-            knobs = [k for r in runners for k in r.knobs()]
+            knobs = [k for r in runners for k in r.knobs()
+                     if k.name not in owned]
             if knobs:
                 tuner = Autotuner(knobs, **autotune_opts)
-        return CompiledPipeline(runners, tuner)
+        built = CompiledPipeline(runners, tuner, tenant=tenant)
+        built.scheduler_owned = owned
+        if sched is not None:
+            try:
+                sched.admit(tenant, built)
+            except Exception:
+                built.close()  # free the runners a failed admission
+                raise          # would otherwise leak
+        return built
 
     # -- introspection
 

@@ -94,6 +94,18 @@ def validate_chain(stages: Tuple[StageSpec, ...]) -> None:
                     f"pipeline: {k!r} before shard is not lowerable — "
                     "shard compiles source+parse directly into "
                     "ShardedRowBlockIter")
+    if stages[0].params.get("stream") is not None:
+        # a streaming source has no frozen byte range: nothing that
+        # needs one (re-read shuffle order, replay caches, byte-range
+        # shards) can sit on it
+        for k in ("shuffle", "cache", "shard"):
+            if k in kinds:
+                raise DMLCError(
+                    f"pipeline: {k!r} is not lowerable over a "
+                    "streaming source (from_stream) — a growing file "
+                    "has no frozen byte range to "
+                    + ("reshuffle" if k == "shuffle" else
+                       "replay" if k == "cache" else "shard"))
     if "shuffle" in kinds:
         i = kinds.index("shuffle")
         if i + 1 < len(kinds) and kinds[i + 1] == "parse":

@@ -685,8 +685,9 @@ def row_loop_lint(paths: List[str],
 # 13's acceptance assert, and scripts/obsctl.py all read THIS key set.
 # The pin below is the one source of truth the gate checks everything
 # against — change the schema by changing both, consciously.
-VERDICT_KEYS = ("schema", "epoch", "verdict_id", "bound", "band",
-                "confidence", "evidence", "hot_frames", "stage_waits")
+VERDICT_KEYS = ("schema", "epoch", "verdict_id", "tenant", "bound",
+                "band", "confidence", "evidence", "hot_frames",
+                "stage_waits")
 _ANALYZE_REL = "dmlc_tpu/obs/analyze.py"
 
 
@@ -905,6 +906,65 @@ def run_clang_format(root: str = NATIVE_SRC) -> Optional[List[str]]:
     return [line for line in proc.stderr.splitlines() if line.strip()]
 
 
+# Thread construction in the pipeline layer is a BUDGET, not a
+# call-site choice: the multi-tenant scheduler (pipeline/scheduler.py)
+# owns the process's thread/queue budgets and time-slices them across
+# tenants — a stage runner spawning its own threading.Thread or pool
+# would be capacity the scheduler can neither see, bill, nor
+# backpressure. Pipeline stages get concurrency by lowering onto the
+# ALREADY-BUDGETED machinery (data/threaded_iter.ThreadedIter — the
+# one audited producer-thread seam, whose capacities the scheduler
+# rebalances — and the native engine's own pools). The list shrinks,
+# it does not grow.
+THREAD_ALLOWED = {
+    "dmlc_tpu/pipeline/scheduler.py",  # the budget owner itself
+}
+_THREAD_DIR = "dmlc_tpu/pipeline/"
+_POOL_NAMES = {"ThreadPoolExecutor", "ProcessPoolExecutor", "Pool"}
+
+
+def thread_lint(paths: List[str],
+                trees: Optional[dict] = None) -> List[str]:
+    """The thread gate: threading.Thread / executor-pool construction
+    in dmlc_tpu/pipeline/ confined to the scheduler module (see
+    above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if not rel.startswith(_THREAD_DIR) or rel in THREAD_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = None
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("threading", "_threading")
+                    and f.attr == "Thread"):
+                name = "threading.Thread"
+            elif isinstance(f, ast.Name) and f.id == "Thread":
+                name = "Thread"
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr in _POOL_NAMES) \
+                    or (isinstance(f, ast.Name)
+                        and f.id in _POOL_NAMES):
+                name = (f.attr if isinstance(f, ast.Attribute)
+                        else f.id)
+            if name:
+                findings.append(
+                    f"{rel}:{node.lineno}: {name} construction in the "
+                    "pipeline layer outside scheduler.py — thread "
+                    "capacity is a scheduler-owned budget; lower onto "
+                    "ThreadedIter (data/threaded_iter.py) or the "
+                    "native engine's pools instead")
+    return findings
+
+
 def main() -> int:
     paths = python_files()
     findings = builtin_lint(paths)
@@ -920,6 +980,7 @@ def main() -> int:
     findings += arrow_lint(paths, trees)
     findings += profile_lint(paths, trees)
     findings += http_client_lint(paths, trees)
+    findings += thread_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

@@ -140,6 +140,10 @@ def render_stage_table(pl: Dict[str, Any]) -> str:
 def render_verdict(v: Dict[str, Any]) -> str:
     lines = [f"bound: {v.get('bound')}   band: {v.get('band')}   "
              f"confidence: {v.get('confidence')}"
+             # schema-4 verdicts carry the tenant whose epoch was
+             # judged (multi-tenant scheduler); untenanted runs omit it
+             + (f"   tenant: {v['tenant']}" if v.get("tenant")
+                else "")
              # schema-3 verdicts are citable (the control ledger
              # references them by id); older BENCH docs lack the field
              + (f"   [{v['verdict_id']}]" if v.get("verdict_id")
@@ -368,6 +372,60 @@ def cmd_control(args) -> int:
     return 0
 
 
+def render_tenants(doc: Dict[str, Any]) -> str:
+    """One /tenants payload -> per-tenant table + plane header."""
+    lines = [f"scheduler: quantum {doc.get('quantum')} · burst "
+             f"{doc.get('burst')} · queue budget "
+             f"{doc.get('queue_budget')} · {doc.get('rounds')} rounds"]
+    hdr = (f"{'tenant':<12} {'pipes':>5} {'share':>5} {'credits':>7} "
+           f"{'pulls':>8} {'p50 ms':>8} {'p99 ms':>8} {'occ':>5} "
+           f"{'verdict':<18}")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for name, t in sorted((doc.get("tenants") or {}).items()):
+        v = t.get("last_verdict") or {}
+        verdict = (f"{v.get('bound')}/{v.get('confidence')}"
+                   if v else "-")
+        if t.get("paused"):
+            verdict = "PAUSED " + verdict
+        ms = [t.get("batch_p50_s"), t.get("batch_p99_s")]
+        ms = [f"{x * 1e3:.1f}" if x is not None else "-" for x in ms]
+        pipes = f"{t.get('pipelines')}/{t.get('max_pipelines')}"
+        lines.append(
+            f"{name:<12} {pipes:>5} {_fmt(t.get('queue_share'), 0):>5} "
+            f"{_fmt(t.get('deficit'), 1):>7} {t.get('pulls', 0):>8} "
+            f"{ms[0]:>8} {ms[1]:>8} "
+            f"{_fmt(t.get('queue_occupancy')):>5} {verdict:<18}")
+        wm = t.get("watermark")
+        if wm:
+            lines.append(
+                f"    stream {wm.get('uri')}: {wm.get('windows')} "
+                f"windows, watermark {wm.get('watermark_records')} "
+                f"records / {wm.get('watermark_bytes')} bytes "
+                f"(advanced {wm.get('last_advance_s_ago')}s ago, "
+                f"{wm.get('retries')} degraded polls)")
+        if t.get("rejected"):
+            lines.append(f"    admission: {t['admitted']} admitted, "
+                         f"{t['rejected']} rejected, "
+                         f"{t['queued']} queued")
+    return "\n".join(lines)
+
+
+def cmd_tenants(args) -> int:
+    port = _default_port(args)
+    doc = _fetch(port, "/tenants", host=args.host)
+    if "tenants" not in doc:
+        # the server's 404 payload ({error, hint}: no scheduler
+        # installed) — surface the hint, exit 2 like history/gang
+        print(json.dumps(doc))
+        return 2
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    print(render_tenants(doc))
+    return 0
+
+
 def cmd_profile(args) -> int:
     port = _default_port(args)
     qs = []
@@ -454,6 +512,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("gang", help="rank 0's merged gang view")
     common(p)
     p.set_defaults(fn=cmd_gang)
+
+    p = sub.add_parser("tenants",
+                       help="a rank's /tenants rows (multi-tenant "
+                            "pipeline scheduler)")
+    common(p)
+    p.set_defaults(fn=cmd_tenants)
 
     p = sub.add_parser("control",
                        help="a rank's /control decision ledger "

@@ -411,6 +411,46 @@ class TestLintGate:
         assert len(findings) == 4, "\n".join(findings)
         assert all("objstore client modules" in f for f in findings)
 
+    def test_thread_gate_clean(self):
+        # threading.Thread / executor pools in dmlc_tpu/pipeline/
+        # confined to scheduler.py (the budget owner)
+        findings = lint.thread_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_thread_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "pipeline",
+                           "_lintprobe10.py")
+        with open(bad, "w") as f:
+            f.write("import threading\n"
+                    "from threading import Thread\n"
+                    "from concurrent.futures import "
+                    "ThreadPoolExecutor\n"
+                    "t = threading.Thread(target=print)\n"
+                    "u = Thread(target=print)\n"
+                    "p = ThreadPoolExecutor(2)\n"
+                    "ok = threading.Lock()\n")  # locks are fine
+        try:
+            findings = lint.thread_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 3, "\n".join(findings)
+        assert all("scheduler-owned budget" in f for f in findings)
+
+    def test_thread_gate_scope_and_allowlist(self):
+        # the scheduler module itself and code OUTSIDE pipeline/ are
+        # exempt (ThreadedIter et al. are the audited seams)
+        sched = os.path.join(lint.REPO, "dmlc_tpu", "pipeline",
+                             "scheduler.py")
+        assert lint.thread_lint([sched]) == []
+        outside = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe11.py")
+        with open(outside, "w") as f:
+            f.write("import threading\n"
+                    "t = threading.Thread(target=print)\n")
+        try:
+            assert lint.thread_lint([outside]) == []
+        finally:
+            os.remove(outside)
+
     def test_http_client_gate_allows_client_modules(self):
         for rel in ("io/objstore/http_client.py", "io/objstore/peer.py",
                     "obs/serve.py"):

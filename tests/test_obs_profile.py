@@ -508,7 +508,7 @@ class TestVerdictHotFrames:
         v = obs_analyze.attribute(self._parse_snap())
         assert v["hot_frames"] == []
         assert sorted(v) == sorted(obs_analyze.VERDICT_KEYS)
-        assert v["schema"] == obs_analyze.ANALYSIS_SCHEMA == 3
+        assert v["schema"] == obs_analyze.ANALYSIS_SCHEMA == 4
 
     def test_live_profiler_feeds_verdict(self):
         obs_prof.install(hz=250)

@@ -1,6 +1,7 @@
 """Native Parquet page decode (ABI 8): byte parity with the pyarrow
 golden across the supported matrix (i32/i64/f32/f64, def-level nulls,
-PLAIN + RLE-dictionary, UNCOMPRESSED + GZIP, multi-page chunks),
+PLAIN + RLE-dictionary, UNCOMPRESSED + SNAPPY + GZIP, multi-page
+chunks),
 row-group-aligned part splits and shards, the fused padded pipeline,
 loud fallback for everything outside the matrix, and the corruption
 contract."""
@@ -91,6 +92,8 @@ class TestNativeParity:
         ("NONE", True, True),
         ("GZIP", False, True),
         ("GZIP", True, False),
+        ("SNAPPY", False, True),
+        ("SNAPPY", True, True),
     ])
     def test_byte_parity(self, tmp_path, rng, compression, use_dict,
                          nulls):
@@ -206,13 +209,33 @@ class TestFallbackMatrix:
         pq.write_table(t, path, **write_kw)
         return path
 
-    def test_snappy_falls_back(self, tmp_path, rng):
+    def test_snappy_decodes_natively(self, tmp_path, rng):
+        """SNAPPY left the fallback matrix: the engine grew a raw
+        snappy page decoder (the most common parquet codec), so
+        engine='auto' keeps the native path and the stream is
+        byte-identical to the golden."""
         path = self._simple(tmp_path, rng, compression="SNAPPY")
+        p = Parser.create(path, 0, 1, format="parquet_native",
+                          engine="auto", label_column="label")
+        assert not isinstance(p, ParquetParser)  # native, no fallback
+        if hasattr(p, "destroy"):
+            p.destroy()
+        n = _drain(path, "native")
+        g = _drain(path, "python")
+        assert _block_eq(n, g)
+
+    def test_zstd_falls_back(self, tmp_path, rng):
+        """zstd stays OUT of the native matrix: create-time fallback
+        under auto, a named error under engine='native'."""
+        try:
+            path = self._simple(tmp_path, rng, compression="ZSTD")
+        except Exception:
+            pytest.skip("pyarrow without zstd support")
         p = Parser.create(path, 0, 1, format="parquet_native",
                           engine="auto", label_column="label")
         assert isinstance(p, ParquetParser)  # the pyarrow golden
         p.destroy()
-        with pytest.raises(DMLCError, match="codec|SNAPPY|snappy|1"):
+        with pytest.raises(DMLCError, match="codec|ZSTD|zstd|6"):
             Parser.create(path, 0, 1, format="parquet_native",
                           engine="native", label_column="label")
 

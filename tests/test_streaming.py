@@ -1,0 +1,364 @@
+"""Streaming ingestion (dmlc_tpu.io.streaming_split +
+Pipeline.from_stream): EOF-less windowed consumption of a growing
+file with advancing watermarks, finite-epoch byte identity once the
+writer stops, chain-validation, and chaos degradation (truncate /
+ioerror faults -> clean windowed retries, never shifted bytes, never
+a hang)."""
+
+import threading
+import time
+
+import pytest
+
+from dmlc_tpu.io.streaming_split import StreamingSplit
+from dmlc_tpu.pipeline import Pipeline
+from dmlc_tpu.resilience import inject
+from dmlc_tpu.resilience.policy import reset_policies
+from dmlc_tpu.utils.logging import DMLCError
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    yield
+    inject.uninstall()
+    reset_policies()
+
+
+def _lines(n, start=0):
+    return [f"{(i + start) % 2} {(i + start) % 40 + 1}:1.5 "
+            f"{(i + start) % 70 + 3}:2.5\n" for i in range(n)]
+
+
+class _Writer:
+    """Append records to a file in timed slices on a thread."""
+
+    def __init__(self, path, total=1200, slice_rows=150,
+                 interval_s=0.02):
+        self.path = str(path)
+        self.rows = _lines(total)
+        self.slice_rows = slice_rows
+        self.interval_s = interval_s
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        open(self.path, "w").close()
+
+    def _run(self):
+        with open(self.path, "a") as f:
+            for i in range(0, len(self.rows), self.slice_rows):
+                f.write("".join(self.rows[i:i + self.slice_rows]))
+                f.flush()
+                time.sleep(self.interval_s)
+
+    def start(self):
+        self.thread.start()
+        return self
+
+    def join(self):
+        self.thread.join()
+
+
+class TestStreamingSplit:
+    def test_consumes_growth_eof_less(self, tmp_path):
+        w = _Writer(tmp_path / "feed.libsvm").start()
+        split = StreamingSplit(w.path, window_records=200,
+                               poll_interval_s=0.01,
+                               idle_timeout_s=0.4)
+        records = list(split)
+        w.join()
+        assert len(records) == 1200
+        assert records == [ln.strip().encode() for ln in w.rows]
+
+    def test_watermark_advances_monotonically(self, tmp_path):
+        w = _Writer(tmp_path / "feed.libsvm").start()
+        split = StreamingSplit(w.path, window_records=128,
+                               poll_interval_s=0.01,
+                               idle_timeout_s=0.4)
+        marks = []
+        while (chunk := split.next_chunk()) is not None:
+            wm = split.watermark()
+            marks.append((wm["windows"], wm["watermark_bytes"],
+                          wm["watermark_records"]))
+            assert chunk
+        w.join()
+        assert len(marks) >= 4
+        for a, b in zip(marks, marks[1:]):
+            assert b[0] > a[0] and b[1] > a[1] and b[2] > a[2]
+        assert split.watermark()["ended"] is True
+
+    def test_count_windows_are_bounded(self, tmp_path):
+        w = _Writer(tmp_path / "feed.libsvm", total=600,
+                    slice_rows=600).start()
+        w.join()  # all bytes present before the first poll
+        split = StreamingSplit(w.path, window_records=100,
+                               poll_interval_s=0.01,
+                               idle_timeout_s=0.3)
+        sizes = []
+        while (chunk := split.next_chunk()) is not None:
+            sizes.append(sum(1 for ln in chunk.splitlines() if ln))
+        # the poll reads up to chunk_size at once; the window closes
+        # AT or past the count bound within one poll's whole records
+        assert sum(sizes) == 600
+        assert all(s >= 100 for s in sizes[:-1])
+
+    def test_time_window_flushes_partial(self, tmp_path):
+        w = _Writer(tmp_path / "feed.libsvm", total=90,
+                    slice_rows=30, interval_s=0.05).start()
+        split = StreamingSplit(w.path, window_records=10 ** 6,
+                               window_s=0.06, poll_interval_s=0.01,
+                               idle_timeout_s=0.5)
+        n_windows = 0
+        total = 0
+        while (chunk := split.next_chunk()) is not None:
+            n_windows += 1
+            total += sum(1 for ln in chunk.splitlines() if ln)
+        w.join()
+        assert total == 90
+        assert n_windows >= 2  # time closed windows below the count
+
+    def test_stop_drains_and_ends(self, tmp_path):
+        path = tmp_path / "feed.libsvm"
+        path.write_text("".join(_lines(50)))
+        split = StreamingSplit(str(path), poll_interval_s=0.01)
+        split.stop()
+        chunk = split.next_chunk()
+        assert chunk is not None
+        assert sum(1 for ln in chunk.splitlines() if ln) == 50
+        assert split.next_chunk() is None
+        assert split.watermark()["ended"] is True
+
+    def test_stop_drains_unterminated_tail(self, tmp_path):
+        """Once the writer stops, a final record without a trailing
+        newline is still part of the finite-file epoch."""
+        path = tmp_path / "feed.libsvm"
+        path.write_text("1 2:1.5\n0 3:2.5")  # no trailing newline
+        split = StreamingSplit(str(path), poll_interval_s=0.01)
+        split.stop()
+        records = list(split)
+        assert records == [b"1 2:1.5", b"0 3:2.5"]
+
+    def test_cannot_rewind_or_shard(self, tmp_path):
+        path = tmp_path / "feed.libsvm"
+        path.write_text("".join(_lines(10)))
+        split = StreamingSplit(str(path), poll_interval_s=0.01,
+                               idle_timeout_s=0.1)
+        list(split)
+        with pytest.raises(DMLCError, match="cannot rewind"):
+            split.before_first()
+        with pytest.raises(DMLCError, match="one part"):
+            split.reset_partition(1, 2)
+
+    def test_shrunk_source_raises(self, tmp_path):
+        path = tmp_path / "feed.libsvm"
+        path.write_text("".join(_lines(100)))
+        split = StreamingSplit(str(path), window_records=50,
+                               poll_interval_s=0.01,
+                               idle_timeout_s=2.0)
+        assert split.next_chunk() is not None
+        path.write_text("0 1:1\n")  # REWRITE below the watermark
+        with pytest.raises(DMLCError, match="shrank"):
+            while split.next_chunk() is not None:
+                pass
+
+    def test_short_read_at_stop_never_tears_a_record(self, tmp_path):
+        """Post-review pin: the stop-time tail force-commit applies
+        ONLY when the read reached the source's true end — an
+        injected-truncate SHORT read at stop must re-poll, never
+        commit the torn prefix as a record."""
+        path = tmp_path / "feed.libsvm"
+        path.write_text("1 2:1.5")  # one unterminated record
+        inject.install("site=io.stream.read,fault=truncate,times=1")
+        split = StreamingSplit(str(path), poll_interval_s=0.01)
+        split.stop()
+        records = list(split)
+        assert inject.active().injected >= 1
+        assert records == [b"1 2:1.5"]  # whole, never [prefix, rest]
+
+    def test_record_larger_than_chunk_raises(self, tmp_path):
+        """Post-review pin: a record that cannot fit the poll buffer
+        fails LOUD instead of re-reading the buffer forever (or being
+        silently dropped at idle timeout)."""
+        path = tmp_path / "feed.libsvm"
+        path.write_text("0 " + "1:1 " * 40000 + "\n")  # ~160 KB line
+        split = StreamingSplit(str(path), poll_interval_s=0.01,
+                               chunk_size=1)  # clamps to 64 KiB
+        with pytest.raises(DMLCError, match="exceeds chunk_size"):
+            split.next_chunk()
+
+    def test_idle_drain_commits_unterminated_tail(self, tmp_path):
+        """Post-review pin: idle expiry takes one stop-style drain
+        pass — a writer that stopped mid-line still yields the tail
+        record (the finite-file epoch would parse it)."""
+        path = tmp_path / "feed.libsvm"
+        path.write_text("1 2:1.5\n0 3:2.5")  # no trailing newline
+        split = StreamingSplit(str(path), poll_interval_s=0.01,
+                               idle_timeout_s=0.15)
+        records = list(split)
+        assert records == [b"1 2:1.5", b"0 3:2.5"]
+
+    def test_slow_mid_record_writer_not_idle_dropped(self, tmp_path):
+        """Post-review pin: RAW byte growth resets the idle clock — a
+        writer trickling one long line slower than records appear is
+        alive, not idle, and its half-line is never drained torn."""
+        path = tmp_path / "feed.libsvm"
+        open(path, "w").close()
+
+        def writer():
+            with open(path, "a") as f:
+                for piece in ("1 7:1.5", " 9:2.5", " 11:4.5\n"):
+                    f.write(piece)
+                    f.flush()
+                    time.sleep(0.2)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        split = StreamingSplit(str(path), poll_interval_s=0.01,
+                               idle_timeout_s=0.35)
+        records = list(split)
+        t.join()
+        assert records == [b"1 7:1.5 9:2.5 11:4.5"]
+
+    def test_registered_metrics_collector(self, tmp_path):
+        from dmlc_tpu.obs.metrics import REGISTRY
+        path = tmp_path / "feed.libsvm"
+        path.write_text("".join(_lines(20)))
+        split = StreamingSplit(str(path), poll_interval_s=0.01,
+                               idle_timeout_s=0.1)
+        list(split)
+        snap = REGISTRY.snapshot()
+        key = next(k for k in snap["collectors"]
+                   if k.startswith(f"stream/{path}"))
+        assert snap["collectors"][key]["watermark_records"] == 20
+
+
+class TestStreamingChaos:
+    """FaultPlans on the growing file degrade to clean windowed
+    retries: the consumed stream stays byte-identical to the finite
+    epoch, the degradation is counted, and nothing hangs."""
+
+    def _consume(self, path, **kw):
+        kw.setdefault("window_records", 100)
+        kw.setdefault("poll_interval_s", 0.01)
+        kw.setdefault("idle_timeout_s", 0.5)
+        split = StreamingSplit(str(path), **kw)
+        records = list(split)
+        return records, split
+
+    def test_ioerror_absorbed_by_the_seam(self, tmp_path):
+        """A transient open fault is retried INSIDE the io.stream.open
+        resilience seam — the split never even sees a degraded poll."""
+        w = _Writer(tmp_path / "feed.libsvm").start()
+        inject.install("site=io.stream.open,fault=ioerror,nth=3")
+        records, split = self._consume(w.path)
+        w.join()
+        plan = inject.active()
+        assert plan.injected > 0, "the fault never fired"
+        assert records == [ln.strip().encode() for ln in w.rows]
+
+    def test_ioerror_past_the_ladder_degrades_to_retry(self, tmp_path):
+        """Faults that EXHAUST the retry ladder surface as failed
+        polls: the split counts the degradation, re-polls from the
+        committed watermark, and the stream stays byte-identical."""
+        from dmlc_tpu.resilience import RetryPolicy, set_policy
+        set_policy("io.stream.open",
+                   RetryPolicy(max_attempts=2, base_delay_s=0.0))
+        w = _Writer(tmp_path / "feed.libsvm").start()
+        inject.install("site=io.stream.open,fault=ioerror,times=10")
+        records, split = self._consume(w.path)
+        w.join()
+        plan = inject.active()
+        assert plan.injected == 10
+        assert split.watermark()["retries"] > 0
+        assert records == [ln.strip().encode() for ln in w.rows]
+
+    def test_truncate_degrades_to_retry(self, tmp_path):
+        """An injected truncate (tail of the read dropped, stream
+        pinned at EOF) yields a SHORT poll: the committed watermark
+        re-reads from the record boundary — never shifted bytes."""
+        w = _Writer(tmp_path / "feed.libsvm").start()
+        inject.install("site=io.stream.read,fault=truncate,nth=2")
+        records, split = self._consume(w.path)
+        w.join()
+        plan = inject.active()
+        assert plan.injected > 0, "the fault never fired"
+        assert records == [ln.strip().encode() for ln in w.rows]
+
+    def test_persistent_ioerror_never_hangs(self, tmp_path):
+        path = tmp_path / "feed.libsvm"
+        path.write_text("".join(_lines(100)))
+        inject.install("site=io.stream.open,fault=ioerror")  # every
+        t0 = time.monotonic()
+        records, split = self._consume(path, idle_timeout_s=0.4)
+        assert records == []  # nothing readable, clean end
+        assert time.monotonic() - t0 < 10.0
+        assert split.watermark()["retries"] > 0
+
+
+class TestStreamingPipeline:
+    def test_stream_epoch_matches_finite_epoch(self, tmp_path):
+        """THE streaming acceptance: consumed EOF-less with advancing
+        watermarks; once the writer stops, byte-identical to a finite
+        epoch over the final bytes."""
+        w = _Writer(tmp_path / "feed.libsvm").start()
+        built = (Pipeline.from_stream(w.path, window_records=256,
+                                      poll_interval_s=0.01,
+                                      idle_timeout_s=0.5)
+                 .parse(format="libsvm").batch(512).build())
+        stream_hashes = [b.content_hash() for b in built]
+        w.join()
+        wm = built.stream_stats()
+        assert wm["watermark_records"] == 1200 and wm["windows"] >= 2
+        snap = built.stats()
+        assert snap["stages"][0]["extra"]["stream"][
+            "watermark_bytes"] > 0
+        built.close()
+        finite = (Pipeline.from_uri(w.path)
+                  .parse(format="libsvm", engine="python")
+                  .batch(512).build())
+        finite_hashes = [b.content_hash() for b in finite]
+        finite.close()
+        assert stream_hashes == finite_hashes
+
+    def test_stream_rejects_cache_shuffle_shard(self, tmp_path):
+        p = Pipeline.from_stream(str(tmp_path / "x.libsvm"))
+        with pytest.raises(DMLCError, match="cache"):
+            p.parse(format="libsvm").cache().batch(8).build()
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(jax.devices("cpu")[:1], ("data",))
+        with pytest.raises(DMLCError, match="shard"):
+            p.parse(format="libsvm").shard(mesh).build()
+
+    def test_stream_rejects_split_ignoring_format(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        path = tmp_path / "x.parquet"
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        pq.write_table(pa.table({"label": pa.array(
+            np.zeros(4, np.float32))}), str(path))
+        with pytest.raises(DMLCError, match="from_stream is not"):
+            (Pipeline.from_stream(str(path))
+             .parse(format="parquet", label_column="label")
+             .batch(2).build())
+
+    def test_streaming_tenant_end_to_end(self, tmp_path):
+        """Streaming + multi-tenancy: a tenant-billed streaming
+        pipeline surfaces its watermark on the /tenants row."""
+        from dmlc_tpu.pipeline import scheduler as sched_mod
+        w = _Writer(tmp_path / "feed.libsvm", total=400,
+                    slice_rows=100).start()
+        s = sched_mod.install()
+        try:
+            s.register_tenant("feed")
+            built = (Pipeline.from_stream(w.path, window_records=128,
+                                          poll_interval_s=0.01,
+                                          idle_timeout_s=0.5)
+                     .parse(format="libsvm").batch(128)
+                     .build(tenant="feed"))
+            n = sum(1 for _ in built)
+            w.join()
+            row = s.to_dict()["tenants"]["feed"]
+            assert row["pulls"] == n > 0
+            assert row["watermark"]["watermark_records"] == 400
+            built.close()
+        finally:
+            sched_mod.uninstall()
