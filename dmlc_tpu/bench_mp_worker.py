@@ -41,7 +41,9 @@ def main() -> int:
     from dmlc_tpu.obs.timeseries import install_if_env as hist_if_env
     from dmlc_tpu.obs.trace import trace_if_env
     from dmlc_tpu.pipeline.scheduler import install_if_env as sched_if_env
+    from dmlc_tpu.rendezvous import install_if_env as rndv_if_env
     serve_if_env()
+    rndv_if_env()     # DMLC_TPU_RNDV_URI/PORT: elastic membership
     sched_if_env()    # DMLC_TPU_SCHED: multi-tenant scheduler
     hist_if_env()     # before flight: DMLC_TPU_HISTORY_S must win
     install_if_env()

@@ -95,6 +95,8 @@ def main() -> int:
     if srv is None:
         raise RuntimeError("bench_peer_worker needs "
                            "launch_local(serve_ports=...)")
+    from dmlc_tpu.rendezvous import install_if_env as rndv_if_env
+    rndv_if_env()     # DMLC_TPU_RNDV_URI/PORT: elastic membership
     hist_if_env()     # before flight: DMLC_TPU_HISTORY_S must win
     flight_if_env()
     gang_if_env()     # DMLC_TPU_GANG_POLL_S (rank 0): /gang rollups

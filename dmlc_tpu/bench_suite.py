@@ -68,6 +68,14 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                under contention must stay within the pinned isolation
                bound of its alone baseline (quietest adjacent pair),
                per-tenant accounting in the JSON
+ 20. elastic_reshard — the rendezvous PR's elastic acceptance arc: a
+               REAL gang grows 2→3 mid-epoch (late joiner resumes
+               partially-consumed parts from the committed prefix)
+               then shrinks 3→2 (clean leave, survivors adopt the
+               parts); byte-identical exactly-once coverage of the
+               part-sharded corpus, reshard cost (epoch delivery →
+               first post-reshard commit) and the wire bytes
+               mid-epoch resume saves vs replay-from-zero in the JSON
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 
@@ -1976,6 +1984,115 @@ def bench_multi_tenant(mb: int) -> Dict:
         objstore.configure(None)
 
 
+def bench_elastic_reshard(mb: int) -> Dict:
+    """Config 20 (the rendezvous PR): the elastic N→M acceptance arc
+    as a REAL gang over the object-store emulator. Three worker
+    processes under ``launch_local(rendezvous=True)``: ranks 0-1 join
+    at startup (world 2) and consume a part-sharded corpus through
+    epoch-fenced progress commits; rank 2 joins mid-epoch on rank 0's
+    marker (the 2→3 GROW — it RESUMES the two partially-consumed
+    parts it adopts from the merged progress prefix instead of
+    replaying them), commits a fixed number of batches, then leaves
+    cleanly (the 3→2 SHRINK — survivors adopt its parts the same
+    way). Asserts byte-identical exactly-once coverage (every
+    committed range digest-checked against the local corpus, no gaps,
+    no overlaps), both epoch bumps visible in every rank's delivered
+    membership views, and a gang wire total ≈ 1× the corpus — the
+    saved prefix bytes are exactly what replay-from-zero would have
+    re-pulled."""
+    import hashlib
+    import shutil
+    import sys
+    import tempfile
+
+    import dmlc_tpu.io.objstore as objstore
+    from dmlc_tpu.parallel.launch import launch_local
+
+    N_PARTS, REC = 6, 64 << 10
+    recs = max(24, (mb << 20) // (N_PARTS * REC))
+    size = N_PARTS * recs * REC
+    root = f"{_TMP}.elastic.objroot"
+    em = objstore.configure(root=root)
+    rng = np.random.default_rng(20)
+    corpus = [rng.integers(0, 256, recs * REC,
+                           dtype=np.uint8).tobytes()
+              for _ in range(N_PARTS)]
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_elastic_worker.py")
+    out_dir = tempfile.mkdtemp(prefix="dmlc_bench_elastic_")
+    env = {
+        objstore.ENV_ROOT: root,
+        objstore.ENV_LATENCY: "0.002",  # a modeled wire: GETs cost
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + [p for p in os.environ.get("PYTHONPATH",
+                                         "").split(os.pathsep) if p]),
+    }
+    try:
+        for p, data in enumerate(corpus):
+            em.put("bench", f"elastic/part-{p}.bin", data)
+        t0 = time.perf_counter()
+        launch_local(3, [sys.executable, worker, out_dir,
+                         str(N_PARTS), str(REC), str(recs)],
+                     env=env, serve_ports=True, rendezvous=True,
+                     heartbeat_grace_s=10.0, timeout=600)
+        wall = time.perf_counter() - t0
+        results = []
+        for rank in range(3):
+            with open(os.path.join(out_dir,
+                                   f"elastic-{rank}.json")) as f:
+                results.append(json.load(f))
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+        objstore.configure(None)
+
+    # byte-identical exactly-once coverage: per part, the committed
+    # ranges across the whole gang tile [0, recs) with no gap and no
+    # overlap, each range's digest matching the local corpus slice
+    for p in range(N_PARTS):
+        ranges = sorted((c[1], c[2], c[3], r["rank"])
+                        for r in results for c in r["committed"]
+                        if c[0] == p)
+        cursor = 0
+        for start, end, sha8, rank in ranges:
+            assert start == cursor, \
+                (f"part {p}: coverage {'gap' if start > cursor else 'overlap'}"
+                 f" at record {start} (expected {cursor}, rank {rank})")
+            want = hashlib.sha256(
+                corpus[p][start * REC:end * REC]).hexdigest()[:16]
+            assert sha8 == want, \
+                f"part {p} records [{start},{end}) diverged on rank {rank}"
+            cursor = end
+        assert cursor == recs, \
+            f"part {p}: coverage stops at {cursor}/{recs}"
+    # the arc: a grow to world 3, then a shrink back to 2, in order
+    worlds = sorted({(e[0], e[1]) for r in results
+                     for e in r["epochs"]})
+    grow = [e for e, w in worlds if w == 3]
+    assert grow, "grow to world 3 never delivered"
+    assert any(w == 2 and e > grow[0] for e, w in worlds), \
+        "shrink back to world 2 never delivered"
+    late = next(r for r in results if r["rank"] == 2)
+    assert late["committed"], "the late joiner never committed a batch"
+    saved = sum(r["saved_bytes"] for r in results)
+    assert saved > 0, \
+        "no part was ever resumed mid-epoch (resume path untested)"
+    total_wire = sum(r["wire_bytes"] for r in results)
+    assert total_wire <= 1.3 * size, \
+        (f"gang moved {total_wire} wire bytes for a {size}-byte corpus "
+         "— mid-epoch resume did not prevent replay")
+    costs = [c for r in results for c in r["reshard_costs"]]
+    return {"config": "elastic_reshard", "procs": 3, "bytes": size,
+            "gbps": size / wall / 1e9, "wall_s": round(wall, 3),
+            "reshard_cost_s": round(max(costs), 4) if costs else None,
+            "reshard_count": len(costs),
+            "resume_saved_bytes": saved,
+            "replay_wire_bytes": total_wire + saved,
+            "gang_wire_frac": round(total_wire / size, 4),
+            "late_joiner_batches": len(late["committed"]),
+            "epochs": [list(e) for e in worlds]}
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -1996,13 +2113,14 @@ CONFIGS = {
     17: ("parquet_native", lambda mb, dev: bench_parquet_native(mb)),
     18: ("image_record", lambda mb, dev: bench_image_record(mb)),
     19: ("multi_tenant", lambda mb, dev: bench_multi_tenant(mb)),
+    20: ("elastic_reshard", lambda mb, dev: bench_elastic_reshard(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-19 (0 = all)")
+                    help="1-20 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -2075,9 +2193,11 @@ def main(argv: Optional[List[str]] = None) -> None:
             # (self-warming, pyarrow-golden legs are the slow part)
             # ... config 19's isolation probe manages its own
             # alternating alone/contended segments (a warm pass would
-            # double a multi-second three-tenant run for nothing)
+            # double a multi-second three-tenant run for nothing);
+            # config 20's gang lives the whole 2->3->2 arc itself —
+            # warming it would run a second multi-process gang
             if not args.cold and n not in (7, 8, 9, 10, 11, 13, 14,
-                                           15, 16, 17, 18, 19):
+                                           15, 16, 17, 18, 19, 20):
                 fn(args.mb, args.device)  # warm imports + page cache
             trace_path = None
             if args.trace:

@@ -300,6 +300,19 @@ class ShardedCheckpoint:
                     for k, leaf in leaves],
                 "user": metadata or {},
             }
+            try:
+                # elastic gangs stamp WHO wrote this step (gang,
+                # member, rank, membership epoch, world): a restore
+                # after an N→M reshard reads the stamp and re-derives
+                # shard ownership from the same pure contract
+                # (rendezvous/elastic.py) instead of assuming the
+                # world never changed
+                from dmlc_tpu.rendezvous.elastic import gang_metadata
+                stamp = gang_metadata()
+                if stamp is not None:
+                    meta["rendezvous"] = stamp
+            except Exception:  # noqa: BLE001 — the stamp is
+                pass           # additive; saves never fail for it
             with create_stream(os.path.join(d, "meta.json"), "w") as s:
                 json_dump(meta, s)
         self._barrier()           # all shard files durable

@@ -101,6 +101,7 @@ class PeerTier:
         self._lock = threading.Lock()
         self._fails = [0] * len(self.ports)
         self._snoozed_until = [0.0] * len(self.ports)
+        self._dead: set = set()
 
     # -- topology
 
@@ -116,26 +117,69 @@ class PeerTier:
     def owner_index(self, group_ix: int) -> Optional[int]:
         """The rank owning hydration group ``group_ix`` (fetches it
         from the wire; everyone else asks its /pages first). None when
-        this process IS the owner."""
+        this process IS the owner — including when a DEAD base
+        owner's group round-robins onto this process: a rank the
+        rendezvous declared dead (:meth:`mark_dead`) costs zero
+        probes, its groups reassign deterministically over the
+        survivors instead of degrading to full-span wire fetches for
+        the rest of the run."""
         if not self.ports:
             return None
         owner = group_ix % self.world
+        dead = self._dead
+        if owner in dead:
+            survivors = [i for i in range(self.world)
+                         if i not in dead]
+            if not survivors:
+                return None
+            owner = survivors[group_ix % len(survivors)]
         if owner == self.self_index:
             return None
         return owner
+
+    def mark_dead(self, index: int) -> None:
+        """Declare a rank permanently dead (rendezvous roster says
+        so, or the supervisor reported it): its page groups reassign
+        onto survivors immediately and :meth:`available` answers
+        False without burning breaker probes."""
+        with self._lock:
+            if 0 <= int(index) < self.world:
+                self._dead.add(int(index))
+
+    def refresh(self, ports: List[int],
+                self_port: Optional[int] = None) -> None:
+        """Adopt a new roster IN PLACE (live ObjectSeekStreams hold
+        this instance): new port list in rank order, recomputed self
+        index, dead set cleared, and the breaker fully reset — the
+        3-strike/5s breaker exists for FLAKY peers, and a roster
+        change means the flaky/dead topology it learned is stale."""
+        with self._lock:
+            self.ports = [int(p) for p in ports]
+            self.self_index = None
+            if self_port is not None and int(self_port) in self.ports:
+                self.self_index = self.ports.index(int(self_port))
+            self._fails = [0] * len(self.ports)
+            self._snoozed_until = [0.0] * len(self.ports)
+            self._dead = set()
 
     # -- breaker
 
     def available(self, index: int) -> bool:
         """Whether the peer is currently worth asking (breaker not
-        open). A snoozed peer's groups fetch as full wire spans."""
+        open, not declared dead). A snoozed peer's groups fetch as
+        full wire spans; a DEAD peer's groups have already been
+        reassigned by :meth:`owner_index`."""
         with self._lock:
+            if index in self._dead or index >= len(self._fails):
+                return False
             if self._fails[index] < self.breaker_failures:
                 return True
             return time.monotonic() >= self._snoozed_until[index]
 
     def _note_failure(self, index: int) -> None:
         with self._lock:
+            if index >= len(self._fails):  # refresh() shrank the gang
+                return                     # under an in-flight fetch
             self._fails[index] += 1
             if self._fails[index] >= self.breaker_failures:
                 self._snoozed_until[index] = (time.monotonic()
@@ -143,7 +187,8 @@ class PeerTier:
 
     def _note_success(self, index: int) -> None:
         with self._lock:
-            self._fails[index] = 0
+            if index < len(self._fails):
+                self._fails[index] = 0
 
     # -- the fetch
 
@@ -155,7 +200,7 @@ class PeerTier:
         chaos exhausted the site policy, stale fingerprint, torn
         payload). Never raises, never hangs: attempts are bounded by
         the site's retry policy and each carries ``timeout_s``."""
-        if not self.available(index):
+        if index >= len(self.ports) or not self.available(index):
             _count("miss")
             return None
         url = (f"http://{self.host}:{self.ports[index]}"

@@ -328,6 +328,18 @@ def attribute(pipeline_snap: Dict[str, Any],
                      f"{obj_payload / wall / 1e9:.3f} GB/s "
                      "wire-served)")
         evidence.append(line)
+    resharded = _counter(metrics, "rendezvous.reshard")
+    mem_joins = _counter(metrics, "rendezvous.join")
+    mem_deaths = _counter(metrics, "rendezvous.death")
+    if resharded or mem_joins or mem_deaths:
+        # the gang changed shape DURING this epoch: wire/peer deltas
+        # above include reshard traffic (new owners fast-forwarding
+        # over the page store), so the verdict names the membership
+        # change instead of letting it read as a wire regression
+        evidence.append(
+            f"membership: {int(resharded)} reshard(s) this epoch "
+            f"({int(mem_joins)} join / {int(mem_deaths)} death; "
+            "gang/member/* instants on the trace, roster on /gang)")
     for name, occ in occupancies:
         if occ >= 0.8:
             evidence.append(f"queue {name} {occ:.0%} full "

@@ -71,8 +71,9 @@ from dmlc_tpu.utils.logging import check
 
 __all__ = ["ControlKnob", "DecisionLedger", "Controller",
            "objstore_knobs", "install", "uninstall", "active",
-           "install_if_env", "ENV_CONTROL", "CONTROL_SCHEMA",
-           "RECORD_KEYS", "FAMILY_FOR_BOUND", "FAMILY_FOR_STAGE_KIND"]
+           "install_if_env", "membership_record", "ENV_CONTROL",
+           "CONTROL_SCHEMA", "RECORD_KEYS", "FAMILY_FOR_BOUND",
+           "FAMILY_FOR_STAGE_KIND"]
 
 ENV_CONTROL = "DMLC_TPU_CONTROL"
 
@@ -709,3 +710,45 @@ def install_if_env() -> Optional[Controller]:
     if not raw or raw.strip() in ("0", "false", "no"):
         return None
     return install()
+
+
+def membership_record(event: str, gang: str, epoch: int,
+                      old_world: int, new_world: int,
+                      member: Optional[str] = None,
+                      rank: Optional[int] = None,
+                      ) -> Optional[Dict[str, Any]]:
+    """Land a gang-membership change on the decision ledger
+    (rendezvous plane: join/leave/death/reshard). Membership moves are
+    DECISIONS about the run's shape — world size is the knob, the
+    membership epoch is the evidence — so they share the pinned
+    RECORD_KEYS schema and render in ``obsctl control`` next to the
+    verdict-driven moves they often explain (a reshard is why the
+    next epoch's wire bytes moved). ``verdict_id`` cites the
+    membership epoch (``m<epoch>-<gang>``) the way knob records cite
+    the verdict that caused them. No-op (returns None) without an
+    installed controller — membership is observable on /gang and the
+    trace regardless."""
+    ctl = active()
+    if ctl is None:
+        return None
+    record = {
+        "epoch": int(epoch),
+        "verdict_id": f"m{int(epoch)}-{gang}",
+        "tenant": None,
+        "bound": "membership",
+        "band": None,
+        "evidence": [f"membership epoch {int(epoch)}: {event}"
+                     + (f" of {member}" if member else "")
+                     + f", world {int(old_world)} -> "
+                       f"{int(new_world)}"
+                     + (f" (rank {rank})" if rank is not None
+                        else "")],
+        "family": "gang",
+        "knob": "membership",
+        "old": int(old_world),
+        "new": int(new_world),
+        "outcome": event,
+        "reverted": False,
+    }
+    ctl.ledger.append(record)
+    return record

@@ -28,7 +28,9 @@ rank serves:
   optionally trimmed to the trailing N seconds;
 - ``GET /gang[?seconds=N]`` — the gang aggregator's merged view
   (:mod:`dmlc_tpu.obs.aggregate`, rank 0 / launcher): per-rank series,
-  rollups, explicit unreachable-rank gaps;
+  rollups, explicit unreachable-rank gaps; plus a ``membership``
+  section (roster, ranks, membership epoch) whenever this process has
+  joined a :mod:`dmlc_tpu.rendezvous` gang;
 - ``GET /tenants`` — the multi-tenant scheduler's per-tenant rows
   (:mod:`dmlc_tpu.pipeline.scheduler`): budget, live pipelines,
   credits/deficit, queue share and occupancy, batch p50/p99, streaming
@@ -427,18 +429,38 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/gang":
                 from dmlc_tpu.obs import aggregate as _agg
                 agg = _agg.active()
-                if agg is None:
+                membership = None
+                try:
+                    from dmlc_tpu import rendezvous as _rndv
+                    cli = _rndv.active()
+                    if cli is not None:
+                        membership = cli.view()
+                except Exception:  # noqa: BLE001 — membership rows
+                    pass           # are additive, never a 500
+                if agg is None and membership is None:
                     self._send_json(
-                        {"error": "no gang aggregator installed",
+                        {"error": "no gang aggregator or rendezvous "
+                                  "membership installed",
                          "hint": "set DMLC_TPU_GANG_POLL_S (launch_"
-                                 "local(gang_poll_s=...)) or call "
-                                 "obs.aggregate.install()"},
+                                 "local(gang_poll_s=...)) or join a "
+                                 "rendezvous (launch_local("
+                                 "rendezvous=True) + dmlc_tpu."
+                                 "rendezvous.install_if_env())"},
                         code=404)
                 else:
-                    q = parse_qs(url.query)
-                    raw = q.get("seconds", [None])[0]
-                    last_s = float(raw) if raw else None
-                    self._send_json(agg.view(last_s=last_s))
+                    if agg is not None:
+                        q = parse_qs(url.query)
+                        raw = q.get("seconds", [None])[0]
+                        last_s = float(raw) if raw else None
+                        body = agg.view(last_s=last_s)
+                    else:
+                        body = {"schema": 0}
+                    if membership is not None:
+                        # the elastic half of the gang story: who is
+                        # in, at which rank, under which membership
+                        # epoch (docs/rendezvous.md)
+                        body["membership"] = membership
+                    self._send_json(body)
             elif url.path == "/control":
                 from dmlc_tpu.obs import control as _control
                 ctl = _control.active()

@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import os
 import shlex
-import socket
 import subprocess
 import sys
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -64,6 +63,10 @@ ENV_SCHED = "DMLC_TPU_SCHED"              # multi-tenant scheduler
 # sets DMLC_TPU_FAULTS for every member; the gang supervisor sets
 # DMLC_TPU_ATTEMPT (alias DMLC_NUM_ATTEMPT — the reference's rejoin
 # counter) to 0 on first spawn and bumps it per restart
+# elastic-gang rendezvous contract (dmlc_tpu.rendezvous):
+# launch_local(rendezvous=True) starts the membership service and
+# exports DMLC_TPU_RNDV_URI/PORT (+ DMLC_TPU_RNDV_GANG); workers join
+# with one rendezvous.install_if_env() line
 
 # env contract (reference: slave_envs in tracker.py)
 ENV_COORD = "DMLC_TPU_COORDINATOR_URI"
@@ -93,24 +96,13 @@ def find_free_port(host: str = "127.0.0.1") -> int:
 
 
 def find_free_ports(n: int, host: str = "127.0.0.1") -> List[int]:
-    """``n`` distinct free ports, chosen while ALL probe sockets are
-    held open (ADVICE r5): closing a probe before the next bind lets the
-    OS hand the same port out twice, making back-to-back single-port
-    probes (jax coordinator + PS root) collide on bind — a rare startup
-    flake. The ports are only guaranteed distinct from each other; as
-    with any probe-then-bind scheme, another process can still grab one
-    in the window before the real bind."""
-    check(n >= 1, "find_free_ports needs n >= 1")
-    socks = []
-    try:
-        for _ in range(n):
-            s = socket.socket()
-            s.bind((host, 0))
-            socks.append(s)
-        return [s.getsockname()[1] for s in socks]
-    finally:
-        for s in socks:
-            s.close()
+    """``n`` distinct free ports, all probe sockets held open until
+    chosen (ADVICE r5 — back-to-back single-port probes can collide).
+    The implementation lives with the package's other raw-socket code
+    in ``rendezvous/service.py`` (the scripts/lint.py socket gate);
+    this re-export keeps the historical launcher API."""
+    from dmlc_tpu.rendezvous.service import probe_free_ports
+    return probe_free_ports(n, host)
 
 
 def worker_envs(coordinator: str, num_workers: int,
@@ -216,7 +208,9 @@ def launch_local(num_workers: int, command: Sequence[str],
                  control: Optional[bool] = None,
                  scheduler=None,
                  restart_policy=None,
-                 faults=None) -> List[int]:
+                 faults=None,
+                 rendezvous: bool = False,
+                 heartbeat_grace_s: Optional[float] = None) -> List[int]:
     """Run N worker processes on this host (reference: local.py).
 
     With ``num_servers > 0`` (reference: dmlc-submit --num-servers +
@@ -313,8 +307,22 @@ def launch_local(num_workers: int, command: Sequence[str],
     with DRR pull credits, admission control, and per-tenant rows at
     ``/tenants`` (rendered by ``obsctl tenants``).
 
+    ``rendezvous=True`` makes the gang ELASTIC (docs/rendezvous.md):
+    the launcher starts a :class:`dmlc_tpu.rendezvous.RendezvousService`
+    and exports ``DMLC_TPU_RNDV_URI/PORT`` (+ the gang name) — workers
+    that call ``dmlc_tpu.rendezvous.install_if_env()`` join, heartbeat,
+    and learn roster changes through the membership epoch. The
+    supervisor reports deaths to the service (epoch bumps immediately,
+    not after the heartbeat grace), and a worker whose restart budget
+    is exhausted SHRINKS the gang instead of killing it — survivors
+    re-derive shard ownership (``rendezvous.elastic``) and resume
+    mid-epoch from exchanged progress. ``heartbeat_grace_s`` tunes
+    the service's silent-member death window.
+
     Returns the list of exit codes (workers first in task-id order,
-    then scheduler, then servers). Raises if any process fails.
+    then scheduler, then servers). Raises if any process fails (in an
+    elastic rendezvous gang, a shrink is NOT a failure: dead members'
+    nonzero codes are returned for inspection instead).
     """
     check(num_workers >= 1, "num_workers must be >= 1")
     check(num_servers >= 0, "num_servers must be >= 0")
@@ -357,6 +365,13 @@ def launch_local(num_workers: int, command: Sequence[str],
     )
     if isinstance(restart_policy, int):
         restart_policy = RestartPolicy(max_restarts=restart_policy)
+    rndv_service = None
+    rndv_gang = os.environ.get("DMLC_TPU_RNDV_GANG", "local")
+    if rendezvous:
+        from dmlc_tpu.rendezvous import RendezvousService
+        kw = ({"heartbeat_grace_s": float(heartbeat_grace_s)}
+              if heartbeat_grace_s is not None else {})
+        rndv_service = RendezvousService(**kw)
     fault_spec = fault_seed = None
     if faults is not None:
         if isinstance(faults, str):
@@ -395,6 +410,13 @@ def launch_local(num_workers: int, command: Sequence[str],
             wenv[ENV_GANG_POLL_S] = str(gang_poll_s)
         if profile_hz is not None:
             wenv[ENV_PROFILE_HZ] = str(profile_hz)
+        if rndv_service is not None:
+            from dmlc_tpu.rendezvous import (
+                ENV_RNDV_GANG, ENV_RNDV_PORT, ENV_RNDV_URI,
+            )
+            wenv[ENV_RNDV_URI] = rndv_service.host
+            wenv[ENV_RNDV_PORT] = str(rndv_service.port)
+            wenv[ENV_RNDV_GANG] = rndv_gang
         if control:
             wenv[ENV_CONTROL] = "1"
         if scheduler:
@@ -420,9 +442,18 @@ def launch_local(num_workers: int, command: Sequence[str],
     # failure or is restarted under restart_policy), the timeout, and
     # PS-role drain once every worker finished (the pre-resilience loop
     # hung on service roles that wait for work forever).
-    codes = GangSupervisor(members, restart_policy=restart_policy,
-                           timeout=timeout, trace_dir=trace_dir,
-                           flight_dir=flight_dir).run()
+    try:
+        codes = GangSupervisor(
+            members, restart_policy=restart_policy,
+            timeout=timeout, trace_dir=trace_dir,
+            flight_dir=flight_dir,
+            rendezvous_addr=(rndv_service.address
+                             if rndv_service is not None else None),
+            rendezvous_gang=rndv_gang,
+            elastic=rndv_service is not None).run()
+    finally:
+        if rndv_service is not None:
+            rndv_service.close()
     if trace_dir is not None:
         merge_gang_traces(trace_dir)
     return codes

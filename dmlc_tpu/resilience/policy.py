@@ -226,6 +226,12 @@ _BUILTIN_SITE_DEFAULTS: List[Tuple[str, Dict[str, Any]]] = [
     ("obs.scrape", {"max_attempts": 2, "base_delay_s": 0.05}),
     ("io.objstore.peer", {"max_attempts": 4, "base_delay_s": 0.05,
                           "max_delay_s": 0.5}),
+    # membership ops (join/heartbeat/leave): a flaky connection must
+    # be a counted retry, not a membership flap — the ladder stays
+    # well inside the service's heartbeat grace window so retries
+    # never masquerade as a missed beat
+    ("rendezvous.*", {"max_attempts": 3, "base_delay_s": 0.05,
+                      "max_delay_s": 0.3}),
 ]
 
 _lock = threading.Lock()

@@ -51,12 +51,17 @@ from typing import Any, Dict, List, Optional, Sequence
 from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = ["RestartPolicy", "GangMember", "GangSupervisor",
-           "ENV_ATTEMPT"]
+           "ENV_ATTEMPT", "ENV_ATTEMPT_ALIAS"]
 
 # restart-attempt env contract (reference: DMLC_NUM_ATTEMPT, set as an
 # alias too): 0 on first spawn, +1 per supervisor restart. Fault-plan
 # clauses scope on it (attempt=0 = "only before the first restart").
 ENV_ATTEMPT = "DMLC_TPU_ATTEMPT"
+# the reference tracker's own name for the same counter (SURVEY §2.3):
+# spawn() stamps BOTH on every (re)spawn so reference-style workers
+# and the rendezvous join contract read the attempt without knowing
+# this repo's prefix
+ENV_ATTEMPT_ALIAS = "DMLC_NUM_ATTEMPT"
 
 
 @dataclass
@@ -100,7 +105,7 @@ class GangMember:
     def spawn(self) -> None:
         env = dict(self.env)
         env[ENV_ATTEMPT] = str(self.attempt)
-        env["DMLC_NUM_ATTEMPT"] = str(self.attempt)
+        env[ENV_ATTEMPT_ALIAS] = str(self.attempt)
         self.proc = subprocess.Popen(self.command, env=env)
 
     def running(self) -> bool:
@@ -117,7 +122,10 @@ class GangSupervisor:
                  poll_interval_s: float = 0.05,
                  trace_dir: Optional[str] = None,
                  flight_dir: Optional[str] = None,
-                 ps_grace_s: float = 10.0):
+                 ps_grace_s: float = 10.0,
+                 rendezvous_addr: Optional[tuple] = None,
+                 rendezvous_gang: str = "local",
+                 elastic: bool = False):
         check(len(members) >= 1, "GangSupervisor needs members")
         self.members = members
         self.restart_policy = restart_policy
@@ -125,6 +133,15 @@ class GangSupervisor:
         self.poll_interval_s = poll_interval_s
         self.trace_dir = trace_dir
         self.flight_dir = flight_dir
+        # rendezvous wiring (launch_local(rendezvous=True)): deaths
+        # are REPORTED to the service — the membership epoch bumps
+        # immediately instead of waiting out the heartbeat grace —
+        # and with ``elastic`` a worker whose restart budget is gone
+        # LEAVES the gang (survivors reshard over the new world)
+        # rather than killing it
+        self.rendezvous_addr = rendezvous_addr
+        self.rendezvous_gang = rendezvous_gang
+        self.elastic = bool(elastic)
         # how long PS service roles may linger after the last worker
         # finishes before the supervisor terminates them: roles that
         # exit on their own (role-generic test binaries) get to, while
@@ -150,6 +167,24 @@ class GangSupervisor:
             if self._rec is not None:
                 self._rec.instant(name, "resilience", payload)
         except Exception:  # noqa: BLE001 — telemetry must not kill the gang
+            pass
+
+    def _report_death(self, m: GangMember) -> None:
+        """Tell the rendezvous service a member died — supervision is
+        the FAST death signal (the heartbeat grace is the slow one):
+        the epoch bumps now, survivors learn the shrunken roster at
+        their next beat. Best-effort: a missing or already-closed
+        service must never take the supervisor down."""
+        if self.rendezvous_addr is None:
+            return
+        try:
+            from dmlc_tpu.rendezvous import service as _rndv
+            _rndv.call(self.rendezvous_addr[0],
+                       self.rendezvous_addr[1],
+                       {"op": "report_death",
+                        "gang": self.rendezvous_gang,
+                        "member": m.name}, timeout_s=1.0)
+        except Exception:  # noqa: BLE001 — best-effort report
             pass
 
     def _note_restart(self, m: GangMember, rc: int, delay: float) -> None:
@@ -310,12 +345,26 @@ class GangSupervisor:
                         m.code = 0
                         self._event("exit", m, {"code": 0})
                         continue
+                    self._report_death(m)
                     if self._may_restart(m):
                         m.restarts += 1
                         delay = self.restart_policy.backoff_for(
                             m.restarts)
                         m.restart_due = now + delay
                         self._note_restart(m, rc, delay)
+                        continue
+                    if (self.elastic and m.role == "worker"
+                            and any(x is not m and x.code is None
+                                    for x in self.members
+                                    if x.role == "worker")):
+                        # elastic mode: a permanently dead worker is
+                        # a membership SHRINK, not a gang failure —
+                        # the death report above bumped the epoch and
+                        # the survivors reshard (rendezvous/elastic);
+                        # its nonzero code is returned, not raised
+                        m.code = rc
+                        self._event("death", m, {"code": rc,
+                                                 "elastic": True})
                         continue
                     self._fail(m, rc,
                                budget_exhausted=(

@@ -519,6 +519,55 @@ def http_client_lint(paths: List[str],
     return findings
 
 
+# Raw sockets are a SEAM: dmlc_tpu/rendezvous/service.py is the ONE
+# home for socket/socketserver construction (the TCP membership
+# service, its line-protocol client transport, and the free-port
+# probe parallel/launch.py re-exports), with obs/serve.py allowed for
+# its HTTP plane (http.server builds on socketserver). Anywhere else,
+# an ad-hoc socket would bypass the rendezvous wire protocol, the
+# rendezvous.* retry seams, and the bounded-handler discipline. The
+# list shrinks, it does not grow.
+SOCKET_ALLOWED = {
+    "dmlc_tpu/rendezvous/service.py",
+    "dmlc_tpu/obs/serve.py",
+}
+_SOCKET_MODULES = {"socket", "socketserver"}
+
+
+def socket_lint(paths: List[str],
+                trees: Optional[dict] = None) -> List[str]:
+    """The socket gate: ``socket``/``socketserver`` imports in
+    dmlc_tpu/ confined to rendezvous/service.py and obs/serve.py
+    (see above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel in SOCKET_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            hits = []
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] in _SOCKET_MODULES:
+                        hits.append(a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                if node.module.split(".")[0] in _SOCKET_MODULES:
+                    hits.append(node.module)
+            for hit in hits:
+                findings.append(
+                    f"{rel}:{node.lineno}: {hit} import outside "
+                    "rendezvous/service.py — raw TCP goes through "
+                    "the rendezvous wire protocol (service.call / "
+                    "probe_free_ports) so the bounded-handler "
+                    "discipline and rendezvous.* retry seams apply")
+    return findings
+
+
 # the two pre-resilience "skip this file and move on" handlers (spill
 # sweeps): genuinely skip-not-retry, pinned. New code classifies and
 # retries through dmlc_tpu.resilience instead.
@@ -980,6 +1029,7 @@ def main() -> int:
     findings += arrow_lint(paths, trees)
     findings += profile_lint(paths, trees)
     findings += http_client_lint(paths, trees)
+    findings += socket_lint(paths, trees)
     findings += thread_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:

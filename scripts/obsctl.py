@@ -273,12 +273,39 @@ def cmd_history(args) -> int:
     return 0
 
 
+def render_membership(mem: Dict[str, Any]) -> str:
+    """One /gang ``membership`` section -> roster rows (the elastic
+    half: who is in, at which rank, under which membership epoch)."""
+    lines = [f"membership: gang {mem.get('gang')!r} epoch "
+             f"{mem.get('epoch')} · world {mem.get('world')} · "
+             f"this member {mem.get('member')} (rank "
+             f"{mem.get('rank')})"]
+    for e in sorted(mem.get("roster") or [],
+                    key=lambda e: e.get("rank", 0)):
+        port = e.get("port")
+        lines.append(f"  rank {e.get('rank')}  {e.get('member')}  "
+                     f"{e.get('host')}" + (f":{port}" if port else "")
+                     + f"  attempt {e.get('attempt')}")
+    prog = mem.get("progress") or {}
+    if prog:
+        done = sum(int(v) for v in prog.values())
+        lines.append(f"  progress: {len(prog)} part(s) started, "
+                     f"{done} records committed gang-wide")
+    return "\n".join(lines)
+
+
 def cmd_gang(args) -> int:
     port = _default_port(args)
     g = _fetch(port, "/gang", host=args.host)
-    if args.json or "ranks" not in g:
+    has_ranks = "ranks" in g
+    membership = g.get("membership")
+    if args.json or (not has_ranks and not membership):
         print(json.dumps(g))
-        return 0 if "ranks" in g else 2
+        return 0 if (has_ranks or membership) else 2
+    if membership:
+        print(render_membership(membership))
+    if not has_ranks:
+        return 0
     print(f"gang of {len(g['ports'])} (poll {g['period_s']}s, "
           f"{g['polls']} polls)")
     data_plane = False

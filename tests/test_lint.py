@@ -411,6 +411,33 @@ class TestLintGate:
         assert len(findings) == 4, "\n".join(findings)
         assert all("objstore client modules" in f for f in findings)
 
+    def test_socket_gate_clean(self):
+        # raw socket/socketserver imports in dmlc_tpu/ confined to
+        # rendezvous/service.py + obs/serve.py (the rendezvous wire
+        # protocol and the HTTP status plane)
+        findings = lint.socket_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_socket_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe12.py")
+        with open(bad, "w") as f:
+            f.write("import socket\n"
+                    "import socketserver\n"
+                    "from socket import create_connection\n"
+                    "from urllib.parse import urlparse\n")  # fine
+        try:
+            findings = lint.socket_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 3, "\n".join(findings)
+        assert all("rendezvous/service.py" in f for f in findings)
+
+    def test_socket_gate_allows_service_and_serve(self):
+        for rel in ("rendezvous/service.py", "obs/serve.py"):
+            path = os.path.join(lint.REPO, "dmlc_tpu",
+                                *rel.split("/"))
+            assert lint.socket_lint([path]) == [], rel
+
     def test_thread_gate_clean(self):
         # threading.Thread / executor pools in dmlc_tpu/pipeline/
         # confined to scheduler.py (the budget owner)
