@@ -2093,6 +2093,150 @@ def bench_elastic_reshard(mb: int) -> Dict:
             "epochs": [list(e) for e in worlds]}
 
 
+def bench_ckpt_restore_fanout(mb: int) -> Dict:
+    """Config 21 (the checkpoint PR): the device-direct sharded
+    checkpoint arc as two REAL gangs over one ``obj://`` root. A
+    three-writer gang saves disjoint leaves mid-epoch (rendezvous
+    stamp in meta.json), then re-saves with ONE of 96 leaves mutated
+    — the incremental path must upload only that leaf's pages. A
+    two-rank gang (a DIFFERENT world: the elastic re-cut) then
+    restores the full checkpoint cold: each rank prefetches only the
+    pages ``content_owner`` assigns to it at world 2 and takes the
+    rest from its peer's ``/pages`` tier, so per-rank wire lands near
+    1/2 the checkpoint (asserted ≤ 0.60×) while every leaf restores
+    byte-identical to what the 3-writer gang saved. Finally the
+    multipart write plane is measured alone on a bandwidth-shaped
+    emulator: parallel part PUTs must beat the single-shot PUT ≥ 2×."""
+    import shutil
+    import sys
+    import tempfile
+
+    import dmlc_tpu.io.objstore as objstore
+    from dmlc_tpu.io.objstore.emulator import EmulatedObjectStore
+    from dmlc_tpu.io.stream import create_stream
+    from dmlc_tpu.parallel.launch import launch_local
+
+    root = f"{_TMP}.ckpt.objroot"
+    shutil.rmtree(root, ignore_errors=True)
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_ckpt_worker.py")
+    out_dir = tempfile.mkdtemp(prefix="dmlc_bench_ckpt_")
+    env = {
+        objstore.ENV_ROOT: root,
+        objstore.ENV_LATENCY: "0.002",  # a modeled wire: every op costs
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + [p for p in os.environ.get("PYTHONPATH",
+                                         "").split(os.pathsep) if p]),
+    }
+    try:
+        t0 = time.perf_counter()
+        launch_local(3, [sys.executable, worker, out_dir, "save",
+                         str(mb)], env=env, rendezvous=True,
+                     timeout=600)
+        save_wall = time.perf_counter() - t0
+        saves = []
+        for rank in range(3):
+            with open(os.path.join(out_dir, f"save-{rank}.json")) as f:
+                saves.append(json.load(f))
+        t0 = time.perf_counter()
+        launch_local(2, [sys.executable, worker, out_dir, "restore",
+                         str(mb)], env=env, serve_ports=True,
+                     timeout=600)
+        restore_wall = time.perf_counter() - t0
+        restores = []
+        for rank in range(2):
+            with open(os.path.join(out_dir,
+                                   f"restore-{rank}.json")) as f:
+                restores.append(json.load(f))
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+    # byte-identical across the world change: every leaf the 3-writer
+    # gang saved (post-mutation step) restores with the same digest on
+    # BOTH ranks of the world-2 gang
+    want = {}
+    for r in saves:
+        want.update(r["leaves"])
+    for r in restores:
+        assert r["step"] == 6, f"rank {r['rank']} restored {r['step']}"
+        assert r["leaves"] == want, \
+            (f"rank {r['rank']}: different-world restore diverged on "
+             f"{sorted(k for k in want if r['leaves'].get(k) != want[k])}")
+    total = restores[0]["restored_bytes"]
+    assert total > 0 and restores[1]["restored_bytes"] == total
+    # THE fanout acceptance: each rank's wire ≤ 0.60× the naive
+    # all-wire restore (ideal is 1/2 at world 2 + index/meta overhead)
+    worst = max(r["wire_bytes"] for r in restores)
+    assert worst <= 0.60 * total, \
+        (f"per-rank restore wire {worst} > 0.60x naive {total} "
+         "— the peer fanout is not cutting the wire")
+    gang_wire = sum(r["wire_bytes"] for r in restores)
+    assert gang_wire <= 1.3 * total, \
+        f"gang moved {gang_wire} wire bytes for a {total}-byte restore"
+    peer_bytes = sum(r["split"]["peer"] for r in restores)
+    assert peer_bytes > 0, "no page was ever peer-served"
+    # the incremental save: one leaf of 96 changed, so the re-save
+    # uploads a sliver and dedups the rest by content digest
+    full = sum(r["full_written"] for r in saves)
+    incr = sum(r["incr_written"] for r in saves)
+    assert 0 < incr <= 0.2 * full, \
+        (f"incremental save uploaded {incr} of a {full}-byte "
+         "checkpoint with 1/96 leaves changed")
+    assert sum(r["incr_reused"] for r in saves) > 0
+
+    # the multipart write plane alone, on a bandwidth-shaped wire slow
+    # enough that the modeled transfer dominates local disk/copy cost
+    # (tmpfs when available — real disk writeback noise can swamp the
+    # model): parallel part PUTs vs the single-shot PUT of the payload
+    mp_bytes = 48 << 20
+    mp_root = (os.path.join("/dev/shm", "dmlc_bench_mp.objroot")
+               if os.path.isdir("/dev/shm")
+               else f"{_TMP}.ckpt.mproot")
+    shaped = EmulatedObjectStore(mp_root, latency_s=0.002,
+                                 bandwidth_gbps=0.05)
+    payload = np.random.default_rng(21).integers(
+        0, 256, mp_bytes, dtype=np.uint8).tobytes()
+    try:
+        objstore.configure(shaped, put_part_bytes=8 << 20,
+                           put_parallel=8)
+        t0 = time.perf_counter()
+        with create_stream("obj://bench/mp.bin", "w") as s:
+            s.write(payload)
+        multi_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        shaped.put("bench", "single.bin", payload)
+        single_s = time.perf_counter() - t0
+        assert shaped.get("bench", "mp.bin") == payload
+        assert shaped.counters()["put_parts"] >= 6
+    finally:
+        objstore.configure(None)
+        shutil.rmtree(mp_root, ignore_errors=True)
+        shutil.rmtree(root, ignore_errors=True)
+    speedup = single_s / multi_s
+    assert speedup >= 2.0, \
+        (f"multipart PUT {multi_s:.3f}s vs single-shot {single_s:.3f}s "
+         f"({speedup:.2f}x) — parallel parts are not hiding the wire")
+
+    wall = max(r["wall_s"] for r in restores)
+    return {"config": "ckpt_restore_fanout", "procs": 5,
+            "bytes": total, "gbps": total / wall / 1e9,
+            "save_wall_s": round(save_wall, 3),
+            "restore_wall_s": round(restore_wall, 3),
+            "per_rank_wire_frac": round(worst / total, 4),
+            "gang_wire_frac": round(gang_wire / total, 4),
+            "restore_split": {
+                k: sum(r["split"][k] for r in restores)
+                for k in ("local", "peer", "wire")},
+            "incremental_frac": round(incr / full, 4),
+            "incremental_bytes": incr,
+            "full_save_bytes": full,
+            "multipart_speedup": round(speedup, 2),
+            "multipart_s": round(multi_s, 3),
+            "single_shot_s": round(single_s, 3)}
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -2114,13 +2258,15 @@ CONFIGS = {
     18: ("image_record", lambda mb, dev: bench_image_record(mb)),
     19: ("multi_tenant", lambda mb, dev: bench_multi_tenant(mb)),
     20: ("elastic_reshard", lambda mb, dev: bench_elastic_reshard(mb)),
+    21: ("ckpt_restore_fanout",
+         lambda mb, dev: bench_ckpt_restore_fanout(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-20 (0 = all)")
+                    help="1-21 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -2195,9 +2341,10 @@ def main(argv: Optional[List[str]] = None) -> None:
             # alternating alone/contended segments (a warm pass would
             # double a multi-second three-tenant run for nothing);
             # config 20's gang lives the whole 2->3->2 arc itself —
-            # warming it would run a second multi-process gang
+            # warming it would run a second multi-process gang; config
+            # 21 runs two gangs (save, then a cold restore) already
             if not args.cold and n not in (7, 8, 9, 10, 11, 13, 14,
-                                           15, 16, 17, 18, 19, 20):
+                                           15, 16, 17, 18, 19, 20, 21):
                 fn(args.mb, args.device)  # warm imports + page cache
             trace_path = None
             if args.trace:

@@ -476,6 +476,14 @@ def sweep_stale_spill(spill_dir: Optional[str] = None,
     explicit cache paths, hydrated remote blocks — one sweep)."""
     if spill_dir is None:
         dirs = {default_store_dir()} | set(PageStore.known_roots())
-        return sum(sweep_stale_spill(d, max_tmp_age_s) for d in dirs)
+        removed = sum(sweep_stale_spill(d, max_tmp_age_s) for d in dirs)
+        try:
+            # the multipart leg: a crashed writer's staged objstore
+            # parts go by the same pid liveness rule as its .tmp pages
+            from dmlc_tpu.io.objstore.multipart import sweep_uploads
+            removed += sweep_uploads()
+        except Exception:  # noqa: BLE001 — sweep is best-effort
+            pass
+        return removed
     return PageStore.at(spill_dir).sweep(max_tmp_age_s,
                                          header_meta=read_spill_meta)

@@ -109,6 +109,11 @@ _options = {
     "codec_level": None,      # io.codec level for the wire + hydrated
                               # pages; None = the process default
                               # (DMLC_TPU_PAGE_CODEC_LEVEL), 0 = raw
+    "put_part_bytes": 8 << 20,  # write streams spill into a multipart
+                              # upload once this many bytes buffer
+                              # (client permitting); smaller objects
+                              # stay single-shot PUTs
+    "put_parallel": 4,        # concurrent part uploads per writer
 }
 
 
@@ -125,7 +130,9 @@ def configure(client_obj=_KEEP, *, root: Optional[str] = None,
               parallel: Optional[int] = None,
               hydrate: Optional[bool] = None,
               peer: Optional[bool] = None,
-              codec_level: Optional[int] = None):
+              codec_level: Optional[int] = None,
+              put_part_bytes: Optional[int] = None,
+              put_parallel: Optional[int] = None):
     """Install the process's object-store client and tune the read
     path. Returns the installed client. The client is, in order:
     ``client_obj`` verbatim; an
@@ -165,12 +172,17 @@ def configure(client_obj=_KEEP, *, root: Optional[str] = None,
                          ("parallel", parallel),
                          ("hydrate", hydrate),
                          ("peer", peer),
-                         ("codec_level", codec_level)):
+                         ("codec_level", codec_level),
+                         ("put_part_bytes", put_part_bytes),
+                         ("put_parallel", put_parallel)):
             if val is not None:
                 _options[key] = val
         check(_options["block_bytes"] >= 1, "block_bytes must be >= 1")
         check(_options["coalesce"] >= 1, "coalesce must be >= 1")
         check(_options["parallel"] >= 1, "parallel must be >= 1")
+        check(_options["put_part_bytes"] >= 1,
+              "put_part_bytes must be >= 1")
+        check(_options["put_parallel"] >= 1, "put_parallel must be >= 1")
     return _client
 
 
@@ -573,31 +585,86 @@ class ObjectSeekStream(SeekStream):
 
 
 class _ObjectWriteStream(Stream):
-    """Buffering write stream: bytes accumulate in RAM and PUT as one
-    object on close (object stores have no append)."""
+    """Write stream over one object. Small objects buffer in RAM and
+    land as a single PUT on close (object stores have no append); once
+    the buffer crosses ``options()["put_part_bytes"]`` — and the client
+    speaks the multipart verbs — the stream spills into a
+    :class:`~dmlc_tpu.io.objstore.multipart.MultipartWriter` and the
+    rest of the bytes travel as bounded-parallel fixed-size parts. Both
+    paths run under the ``io.objstore.put`` seam: transient failures
+    retry, a failed upload leaves NO torn object at the key."""
 
-    def __init__(self, client_obj, bucket: str, key: str, path: str):
+    def __init__(self, client_obj, bucket: str, key: str, path: str,
+                 opts: Optional[dict] = None):
+        opts = opts or options()
         self._c = client_obj
         self._bucket = bucket
         self._key = key
         self.path = path
+        self._part_bytes = int(opts["put_part_bytes"])
+        self._put_parallel = int(opts["put_parallel"])
         self._buf: Optional[MemoryStream] = MemoryStream()
+        self._mp = None
+        self._closed = False
+
+    def _spill(self):
+        """Switch to the multipart writer (None when the client does
+        not speak the verbs — the stream stays single-shot)."""
+        from dmlc_tpu.io.objstore.multipart import (
+            MultipartWriter, supports_multipart,
+        )
+        if not supports_multipart(self._c):
+            return None
+        self._mp = MultipartWriter(
+            self._c, self._bucket, self._key, self.path,
+            part_bytes=self._part_bytes, parallel=self._put_parallel)
+        return self._mp
 
     def write(self, data) -> int:
-        check(self._buf is not None, "objstore: write after close")
-        return self._buf.write(bytes(data))
+        check(not self._closed, "objstore: write after close")
+        if self._mp is not None:
+            return self._mp.write(data)
+        if self._buf.tell() == 0 and len(data) >= self._part_bytes:
+            # a whole part arriving at once: hand it straight to the
+            # multipart writer, never staged through the buffer
+            mp = self._spill()
+            if mp is not None:
+                self._buf = None
+                return mp.write(data)
+        n = self._buf.write(bytes(data))
+        if self._buf.tell() >= self._part_bytes and \
+                self._spill() is not None:
+            self._mp.write(self._buf.getvalue())
+            self._buf = None
+        return n
 
     def read(self, nbytes: int) -> bytes:
         raise DMLCError("objstore: write-only stream")
 
     def close(self) -> None:
-        if self._buf is None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._mp is not None:
+            self._mp.close()
             return
         payload = self._buf.getvalue()
         self._buf = None
-        guarded("io.objstore.put",
-                lambda: self._c.put(self._bucket, self._key, payload))
+
+        def attempt():
+            # the writer owns the bytes: injected truncation (chaos at
+            # io.objstore.put) is detected HERE and retried — a torn
+            # single-shot PUT never lands short
+            data = _inject.corrupt("io.objstore.put", payload)
+            if len(data) != len(payload):
+                raise IOError(
+                    f"objstore: torn PUT on {self.path}: sent "
+                    f"{len(data)}/{len(payload)} bytes")
+            self._c.put(self._bucket, self._key, data)
+
+        guarded("io.objstore.put", attempt)
         _count("put")
+        _count("put.bytes", len(payload))
 
 
 class ObjectStoreFileSystem(FileSystem):

@@ -29,6 +29,16 @@ Protocol mapping (objects live at ``<endpoint>/<bucket>/<key>``):
   change token from ``ETag`` (falling back to ``size-mtime``), mtime
   from ``X-Dmlc-Mtime-Ns`` or ``Last-Modified``;
 - ``put(bucket, key, data)`` — ``PUT`` (2xx = success);
+- multipart (only when constructed with ``multipart=True`` — the
+  dmlc-gateway write convention, gated per-instance exactly like
+  ``encoded``): the upload id is generated client-side
+  (``p<pid>-<nonce>``, pid-embedded for the stale sweep), parts travel
+  as ``PUT <bucket>/<key>?dmlc-upload=<id>&dmlc-part=<n>``, the final
+  object materializes with ``POST ?dmlc-upload=<id>&dmlc-complete=
+  <nparts>`` and a torn upload is dropped with ``POST
+  ?dmlc-upload=<id>&dmlc-abort=1``; ``list_uploads`` reads ``GET
+  <bucket>?dmlc-uploads=1`` so the sweep sees orphaned uploads;
+- ``delete(bucket, key)`` — ``DELETE`` (404 = already gone);
 - ``list(bucket, prefix)`` / ``is_prefix`` — ``GET
   <endpoint>/<bucket>?dmlc-list=<prefix>`` expecting a JSON array of
   ``{key, size, mtime_ns}``: the listing convention a dmlc-aware
@@ -92,7 +102,7 @@ class HttpObjectStoreClient:
     """Ranged-GET object client over one HTTP(S) endpoint."""
 
     def __init__(self, endpoint: str, auth=None, timeout_s: float = 10.0,
-                 encoded: bool = False):
+                 encoded: bool = False, multipart: bool = False):
         u = urlsplit(endpoint if "://" in endpoint
                      else f"http://{endpoint}")
         check(u.scheme in ("http", "https"),
@@ -112,6 +122,15 @@ class HttpObjectStoreClient:
             # "get_encoded"), so only an endpoint KNOWN to speak the
             # dtpc transfer coding exposes the method
             self.get_encoded = self._get_encoded
+        if multipart:
+            # same gate for the write plane: the MultipartWriter probes
+            # hasattr(client, "create_multipart"); a plain endpoint
+            # without the dmlc upload convention stays single-shot
+            self.create_multipart = self._create_multipart
+            self.put_part = self._put_part
+            self.complete_multipart = self._complete_multipart
+            self.abort_multipart = self._abort_multipart
+            self.list_uploads = self._list_uploads
 
     # -- plumbing
 
@@ -308,6 +327,85 @@ class HttpObjectStoreClient:
         from dmlc_tpu.io.stream import create_stream
         with create_stream(src_path, "r") as s:
             return self.put(bucket, key, s.read_all())
+
+    def delete(self, bucket: str, key: str) -> bool:
+        """Remove one object; True when it existed."""
+        status, _, _ = self._request(
+            "DELETE", self._path(bucket, key))
+        if status == 404:
+            return False
+        if status not in (200, 202, 204):
+            self._raise_status(status, f"DELETE {bucket}/{key}")
+        return True
+
+    # -- multipart upload (exposed only with multipart=True)
+
+    def _create_multipart(self, bucket: str, key: str) -> str:
+        """Open an upload. The id is minted client-side (no round
+        trip): ``p<pid>-<nonce>``, pid-embedded so the sweep's
+        liveness rule applies to orphans."""
+        import os as _os
+        self._path(bucket, key)  # validate bucket/key
+        return f"p{_os.getpid()}-{_os.urandom(4).hex()}"
+
+    def _put_part(self, bucket: str, key: str, upload_id: str,
+                  part_num: int, data: bytes) -> None:
+        check(part_num >= 0, "objstore http: negative part number")
+        status, _, _ = self._request(
+            "PUT",
+            self._path(bucket, key,
+                       query=f"dmlc-upload={quote(upload_id)}"
+                             f"&dmlc-part={int(part_num)}"),
+            body=bytes(data),
+            headers={"Content-Type": "application/octet-stream"})
+        if status not in (200, 201, 204):
+            self._raise_status(
+                status, f"PUT part {part_num} {bucket}/{key}")
+
+    def _complete_multipart(self, bucket: str, key: str,
+                            upload_id: str,
+                            nparts: int) -> RemoteObjectInfo:
+        status, _, _ = self._request(
+            "POST",
+            self._path(bucket, key,
+                       query=f"dmlc-upload={quote(upload_id)}"
+                             f"&dmlc-complete={int(nparts)}"))
+        if status == 404:
+            # a part went missing server-side: the upload is torn, not
+            # transient — complete can never succeed, the caller aborts
+            raise FileNotFoundError(
+                f"objstore http: multipart {bucket}/{key} upload "
+                f"{upload_id} has missing parts")
+        if status not in (200, 201, 204):
+            self._raise_status(status, f"COMPLETE {bucket}/{key}")
+        return self.head(bucket, key)
+
+    def _abort_multipart(self, bucket: str, key: str,
+                         upload_id: str) -> None:
+        status, _, _ = self._request(
+            "POST",
+            self._path(bucket, key,
+                       query=f"dmlc-upload={quote(upload_id)}"
+                             "&dmlc-abort=1"))
+        if status not in (200, 204, 404):  # 404 = already gone: fine
+            self._raise_status(status, f"ABORT {bucket}/{key}")
+
+    def _list_uploads(self, bucket: str) -> List[Tuple[str, str]]:
+        """In-flight uploads as ``(upload_id, key)`` via ``GET
+        <bucket>?dmlc-uploads=1`` (JSON array of pairs)."""
+        status, _, data = self._request(
+            "GET", self._path(bucket, query="dmlc-uploads=1"))
+        if status != 200:
+            raise DMLCError(
+                f"objstore http: endpoint has no dmlc-uploads support "
+                f"for {bucket!r} (HTTP {status})")
+        try:
+            return [(str(u), str(k))
+                    for u, k in json.loads(data.decode("utf-8"))]
+        except (ValueError, TypeError) as e:
+            raise DMLCError(
+                f"objstore http: malformed dmlc-uploads reply for "
+                f"{bucket!r}: {e}") from e
 
     def list(self, bucket: str, prefix: str = ""
              ) -> List[RemoteObjectInfo]:

@@ -352,6 +352,21 @@ class PageStore:
         return PageWriter(self, name, fingerprint=fingerprint, meta=meta,
                           commit_site=commit_site, tmp_suffix=tmp_suffix)
 
+    def commit_bytes(self, name: str, data: bytes, fingerprint=None,
+                     meta: Optional[dict] = None) -> str:
+        """One-shot write-and-commit of a fully materialized payload
+        (the content-addressed checkpoint pages' shape:
+        ``fingerprint=None`` entries are immortal to the stale sweep
+        and served by the gang ``/pages`` tier as-is). Returns the
+        entry path; aborts cleanly on failure."""
+        w = self.writer(name, fingerprint=fingerprint, meta=meta)
+        try:
+            w.write(data)
+        except Exception:
+            w.abort()
+            raise
+        return w.commit()
+
     def lookup(self, name: str, fingerprint=None) -> Optional[str]:
         """Entry path when present and fresh, else None. Counts ONE
         hit or miss. With a ``fingerprint``, a committed stamp that

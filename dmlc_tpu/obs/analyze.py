@@ -328,6 +328,21 @@ def attribute(pipeline_snap: Dict[str, Any],
                      f"{obj_payload / wall / 1e9:.3f} GB/s "
                      "wire-served)")
         evidence.append(line)
+    ck_restore = _counter(metrics, "checkpoint.restore_bytes")
+    if ck_restore:
+        # the checkpoint fanout split: of the bytes restore()
+        # materialized, how many each tier carried — peer-served pages
+        # are the ~1/N-wire claim for gang restores, named as rates
+        ck_local = _counter(metrics, "checkpoint.restore.local_bytes")
+        ck_peer = _counter(metrics, "checkpoint.restore.peer_bytes")
+        ck_wire = _counter(metrics, "checkpoint.restore.wire_bytes")
+        line = (f"checkpoint restore: {int(ck_restore)} bytes "
+                f"({int(ck_local)} local, {int(ck_peer)} peer-served, "
+                f"{int(ck_wire)} wire)")
+        if wall > 0 and (ck_peer or ck_wire):
+            line += (f" — {ck_peer / wall / 1e9:.3f} GB/s peer-served "
+                     f"vs {ck_wire / wall / 1e9:.3f} GB/s wire-served")
+        evidence.append(line)
     resharded = _counter(metrics, "rendezvous.reshard")
     mem_joins = _counter(metrics, "rendezvous.join")
     mem_deaths = _counter(metrics, "rendezvous.death")

@@ -479,14 +479,38 @@ def merge_gang_traces(trace_dir: str,
 
 def launch_ssh(hosts: Sequence[str], command: Sequence[str],
                coordinator: str, num_workers: Optional[int] = None,
-               dry_run: bool = False) -> List[str]:
+               dry_run: bool = False,
+               rendezvous_addr: Optional[Tuple[str, int]] = None,
+               rendezvous_gang: Optional[str] = None) -> List[str]:
     """Generate (and optionally run) per-host ssh commands
-    (reference: ssh.py). Returns the command lines."""
+    (reference: ssh.py). Returns the command lines.
+
+    The rendezvous env contract rides the command lines: pass
+    ``rendezvous_addr=(host, port)`` (and optionally
+    ``rendezvous_gang``) to point every worker at an elastic
+    membership service, or leave them None and the launcher's own
+    ``DMLC_TPU_RNDV_URI/PORT/GANG`` environment (when set) is
+    forwarded — a service bound on the submit host is reachable from
+    every ssh worker, not just the local gang."""
+    from dmlc_tpu.rendezvous import (
+        ENV_RNDV_GANG, ENV_RNDV_PORT, ENV_RNDV_URI,
+    )
     n = num_workers or len(hosts)
+    rndv: Dict[str, str] = {}
+    if rendezvous_addr is not None:
+        rndv[ENV_RNDV_URI] = str(rendezvous_addr[0])
+        rndv[ENV_RNDV_PORT] = str(rendezvous_addr[1])
+    elif os.environ.get(ENV_RNDV_URI) and os.environ.get(ENV_RNDV_PORT):
+        rndv[ENV_RNDV_URI] = os.environ[ENV_RNDV_URI]
+        rndv[ENV_RNDV_PORT] = os.environ[ENV_RNDV_PORT]
+    if rndv:
+        rndv[ENV_RNDV_GANG] = (rendezvous_gang
+                               or os.environ.get(ENV_RNDV_GANG, "local"))
     lines = []
     for task_id in range(n):
         host = hosts[task_id % len(hosts)]
-        envs = worker_envs(coordinator, n, task_id)
+        envs = dict(worker_envs(coordinator, n, task_id))
+        envs.update(rndv)
         env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in envs.items())
         cmd_str = " ".join(shlex.quote(c) for c in command)
         lines.append(f"ssh -o StrictHostKeyChecking=no {host} "

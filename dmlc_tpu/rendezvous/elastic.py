@@ -37,7 +37,7 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 from dmlc_tpu.utils.logging import check
 
 __all__ = ["assign_parts", "owner_of", "reshard_plan", "resume_skip",
-           "gang_metadata"]
+           "content_owner", "gang_metadata"]
 
 
 def assign_parts(num_parts: int, world: int, rank: int) -> List[int]:
@@ -57,6 +57,20 @@ def owner_of(part: int, world: int) -> int:
     :func:`assign_parts` (pure, shared by tests and the planner)."""
     check(world >= 1, "owner_of needs world >= 1")
     return part % world
+
+
+def content_owner(digest: str, world: int) -> int:
+    """The rank owning a content-addressed page in a ``world``-member
+    gang: the digest's leading 48 bits mod world. This is the restore
+    fanout's re-cut — pages were written by the SAVING world (any N),
+    and every RESTORING member (any M) independently maps each digest
+    to the same owner, who wire-fetches it while everyone else takes
+    it from the owner's ``/pages`` tier. Pure, uniform (digests are
+    cryptographic, so leading bits are), and world-size agnostic — the
+    different-world restore needs no negotiation, just this function
+    at the new M."""
+    check(bool(digest), "content_owner needs a digest")
+    return owner_of(int(digest[:12], 16), world)
 
 
 def resume_skip(progress: Optional[Mapping[Any, Any]],

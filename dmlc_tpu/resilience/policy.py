@@ -226,6 +226,11 @@ _BUILTIN_SITE_DEFAULTS: List[Tuple[str, Dict[str, Any]]] = [
     ("obs.scrape", {"max_attempts": 2, "base_delay_s": 0.05}),
     ("io.objstore.peer", {"max_attempts": 4, "base_delay_s": 0.05,
                           "max_delay_s": 0.5}),
+    # the write plane: one torn part of a multipart upload re-sends
+    # just that part — retrying is much cheaper than aborting the
+    # whole upload, so the ladder is a step deeper than the default
+    ("io.objstore.put", {"max_attempts": 4, "base_delay_s": 0.05,
+                         "max_delay_s": 0.5}),
     # membership ops (join/heartbeat/leave): a flaky connection must
     # be a counted retry, not a membership flap — the ladder stays
     # well inside the service's heartbeat grace window so retries

@@ -332,6 +332,17 @@ def cmd_gang(args) -> int:
             print(f"    bytes: wire {_fmt(wire, 0)} · "
                   f"peer-served {_fmt(peer, 0)} · "
                   f"served-to-peers {_fmt(served, 0)}")
+        # the rank's checkpoint-restore byte split: what restore()
+        # materialized and which tier carried it — the fanout's ~1/N
+        # wire claim, per rank
+        ck = v.get("counters.checkpoint.restore_bytes")
+        if ck:
+            print(f"    restore: {_fmt(ck, 0)} bytes · local "
+                  f"{_fmt(v.get('counters.checkpoint.restore.local_bytes'), 0)}"
+                  " · peer "
+                  f"{_fmt(v.get('counters.checkpoint.restore.peer_bytes'), 0)}"
+                  " · wire "
+                  f"{_fmt(v.get('counters.checkpoint.restore.wire_bytes'), 0)}")
         # the rank's control-plane cadence (collectors.control.* ride
         # the same gang timeline): decisions made, climate freezes,
         # reverted trials — the observe→act loop, visible per rank

@@ -3,9 +3,12 @@
 A tiny ``ThreadingHTTPServer`` speaking the dialect
 ``dmlc_tpu.io.objstore.http_client.HttpObjectStoreClient`` expects —
 ranged GET (206 + Content-Range, clamped like real object stores),
-HEAD (Content-Length / ETag / X-Dmlc-Mtime-Ns), PUT, the
-``?dmlc-list=`` JSON listing convention, the optional ``dtpc``
-transfer coding, and an optional required auth header — DELEGATING
+HEAD (Content-Length / ETag / X-Dmlc-Mtime-Ns), PUT, DELETE, the
+``?dmlc-list=`` JSON listing convention, the multipart upload
+convention (``PUT ?dmlc-upload=&dmlc-part=``, ``POST
+?dmlc-complete=`` / ``?dmlc-abort=``, ``GET ?dmlc-uploads=1``), the
+optional ``dtpc`` transfer coding, and an optional required auth
+header — DELEGATING
 storage and ground-truth request counters to an inner
 :class:`~dmlc_tpu.io.objstore.emulator.EmulatedObjectStore`. That
 delegation is the point: the whole emulator-backed objstore suite
@@ -90,6 +93,14 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         bucket, key = self._bucket_key()
         q = parse_qs(url.query)
+        if "dmlc-uploads" in q:
+            body = json.dumps(self._em().list_uploads(bucket)).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if "dmlc-list" in q:
             if not self.server.support_list:
                 return self._not_found()
@@ -165,10 +176,46 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._auth_ok():
             return self._deny()
         bucket, key = self._bucket_key()
+        q = parse_qs(urlparse(self.path).query)
         length = int(self.headers.get("Content-Length", "0") or "0")
         body = self.rfile.read(length)
-        self._em().put(bucket, key, body)
+        if "dmlc-upload" in q and "dmlc-part" in q:
+            self._em().put_part(bucket, key, q["dmlc-upload"][0],
+                                int(q["dmlc-part"][0]), body)
+        else:
+            self._em().put(bucket, key, body)
         self.send_response(201)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_POST(self):  # noqa: N802 — contract
+        """Multipart control plane: complete / abort an upload."""
+        if not self._auth_ok():
+            return self._deny()
+        bucket, key = self._bucket_key()
+        q = parse_qs(urlparse(self.path).query)
+        upload = (q.get("dmlc-upload") or [""])[0]
+        if "dmlc-complete" in q:
+            try:
+                self._em().complete_multipart(
+                    bucket, key, upload, int(q["dmlc-complete"][0]))
+            except FileNotFoundError:
+                return self._not_found()
+            self.send_response(201)
+        elif "dmlc-abort" in q:
+            self._em().abort_multipart(bucket, key, upload)
+            self.send_response(204)
+        else:
+            return self._not_found()
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):  # noqa: N802 — contract
+        if not self._auth_ok():
+            return self._deny()
+        bucket, key = self._bucket_key()
+        existed = self._em().delete(bucket, key)
+        self.send_response(204 if existed else 404)
         self.send_header("Content-Length", "0")
         self.end_headers()
 

@@ -150,6 +150,34 @@ class TestSSHLaunch:
         assert "hostA" in lines[0] and "hostB" in lines[1]
         assert "DMLC_TPU_TASK_ID=3" in lines[3]
         assert "python train.py" in lines[0]
+        # no rendezvous by default: the env contract stays out of the
+        # command lines entirely
+        assert "DMLC_TPU_RNDV" not in "".join(lines)
+
+    def test_rendezvous_env_contract(self):
+        """launch_ssh exports the SAME rendezvous env contract that
+        launch_local gives its workers: DMLC_TPU_RNDV_URI/PORT/GANG,
+        pinned here so remote elastic gangs keep working."""
+        lines = launch_ssh(["hostA", "hostB"], ["python", "train.py"],
+                           "hostA:9000", num_workers=2, dry_run=True,
+                           rendezvous_addr=("hostA", 9100),
+                           rendezvous_gang="g1")
+        for line in lines:
+            assert "DMLC_TPU_RNDV_URI=hostA" in line
+            assert "DMLC_TPU_RNDV_PORT=9100" in line
+            assert "DMLC_TPU_RNDV_GANG=g1" in line
+
+    def test_rendezvous_env_fallback(self, monkeypatch):
+        # a launcher already inside a rendezvous-enabled environment
+        # forwards its own contract when none is given explicitly
+        monkeypatch.setenv("DMLC_TPU_RNDV_URI", "10.0.0.5")
+        monkeypatch.setenv("DMLC_TPU_RNDV_PORT", "9200")
+        monkeypatch.delenv("DMLC_TPU_RNDV_GANG", raising=False)
+        lines = launch_ssh(["h0"], ["python", "t.py"], "h0:9000",
+                           num_workers=1, dry_run=True)
+        assert "DMLC_TPU_RNDV_URI=10.0.0.5" in lines[0]
+        assert "DMLC_TPU_RNDV_PORT=9200" in lines[0]
+        assert "DMLC_TPU_RNDV_GANG=local" in lines[0]
 
 
 class TestLaunchRegressions:

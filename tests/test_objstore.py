@@ -78,7 +78,8 @@ def em(request, tmp_path, monkeypatch):
             HttpObjectStoreClient,
         )
         server = ObjstoreHttpServer(inner)
-        client = HttpObjectStoreClient(server.endpoint, encoded=True)
+        client = HttpObjectStoreClient(server.endpoint, encoded=True,
+                                       multipart=True)
         objstore.configure(client, block_bytes=1 << 15, coalesce=4,
                            parallel=2)
         handle = _HttpBackendHandle(client, inner)
@@ -86,7 +87,9 @@ def em(request, tmp_path, monkeypatch):
     objstore.configure(None, block_bytes=saved["block_bytes"],
                        coalesce=saved["coalesce"],
                        parallel=saved["parallel"],
-                       hydrate=saved["hydrate"])
+                       hydrate=saved["hydrate"],
+                       put_part_bytes=saved["put_part_bytes"],
+                       put_parallel=saved["put_parallel"])
     if server is not None:
         server.close()
     inject.uninstall()
@@ -414,3 +417,171 @@ class TestChaos:
         with pytest.raises((DMLCError, IOError)):
             while split.next_chunk() is not None:
                 pass
+
+
+# --------------------------------------------------- the write plane
+
+class TestMultipart:
+    def _payload(self, n=1 << 18, seed=7):
+        return np.random.RandomState(seed).bytes(n)
+
+    def test_multipart_round_trip_with_part_counters(self, em):
+        from dmlc_tpu.io.objstore.multipart import MultipartWriter
+        data = self._payload(100_000)
+        em.reset_counters()
+        w = MultipartWriter(em, "b", "mp.bin", "obj://b/mp.bin",
+                            part_bytes=1 << 14, parallel=2)
+        for i in range(0, len(data), 7777):
+            w.write(data[i:i + 7777])
+        w.close()
+        assert em.get("b", "mp.bin") == data
+        c = em.counters()
+        # ground truth: every byte moved exactly once, as parts
+        assert c["put_parts"] == -(-len(data) // (1 << 14))
+        assert c["put_bytes"] == len(data)
+        assert c["puts"] == 1  # the complete, not a re-upload
+        assert em.list_uploads("b") == []  # staging area drained
+
+    def test_abort_leaves_no_object_and_no_parts(self, em):
+        from dmlc_tpu.io.objstore.multipart import MultipartWriter
+        w = MultipartWriter(em, "b", "gone.bin", "obj://b/gone.bin",
+                            part_bytes=1 << 12, parallel=2)
+        w.write(self._payload(1 << 14))
+        w.abort()
+        with pytest.raises(FileNotFoundError):
+            em.head("b", "gone.bin")
+        assert em.list_uploads("b") == []
+
+    def test_write_stream_spills_into_multipart(self, em):
+        objstore.configure(put_part_bytes=1 << 14, put_parallel=2)
+        data = self._payload(90_000)
+        em.reset_counters()
+        with create_stream("obj://b/auto.bin", "w") as s:
+            for i in range(0, len(data), 5000):
+                s.write(data[i:i + 5000])
+        assert em.get("b", "auto.bin") == data
+        c = em.counters()
+        assert c["put_parts"] > 0 and c["put_bytes"] == len(data)
+
+    def test_small_write_stream_stays_single_shot(self, em):
+        em.reset_counters()
+        with create_stream("obj://b/small.bin", "w") as s:
+            s.write(b"tiny")
+        assert em.get("b", "small.bin") == b"tiny"
+        c = em.counters()
+        assert c["puts"] == 1 and c["put_parts"] == 0
+
+    def test_complete_with_missing_part_raises(self, em):
+        up = em.create_multipart("b", "torn.bin")
+        em.put_part("b", "torn.bin", up, 0, b"aa")
+        # part 1 never uploaded: complete must refuse, not concatenate
+        with pytest.raises(FileNotFoundError):
+            em.complete_multipart("b", "torn.bin", up, 2)
+        with pytest.raises(FileNotFoundError):
+            em.head("b", "torn.bin")
+        em.abort_multipart("b", "torn.bin", up)
+
+    def test_delete_verb(self, em):
+        em.put("b", "d.bin", b"x")
+        assert em.delete("b", "d.bin") is True
+        assert em.delete("b", "d.bin") is False
+        with pytest.raises(FileNotFoundError):
+            em.head("b", "d.bin")
+
+    def test_put_wire_model_charges_latency(self, em):
+        """Satellite: the emulator wire model charges PUTs too —
+        latency_s applies to put and put_part, so write benchmarks
+        measure a believable wire."""
+        import time as _time
+
+        from dmlc_tpu.io.objstore.emulator import EmulatedObjectStore
+        shaped = EmulatedObjectStore(em.root, latency_s=0.03)
+        t0 = _time.monotonic()
+        shaped.put("b", "lat.bin", b"x" * 100)
+        single = _time.monotonic() - t0
+        assert single >= 0.025
+        up = shaped.create_multipart("b", "lat2.bin")
+        t0 = _time.monotonic()
+        shaped.put_part("b", "lat2.bin", up, 0, b"y" * 100)
+        assert _time.monotonic() - t0 >= 0.025
+        shaped.abort_multipart("b", "lat2.bin", up)
+
+
+class TestPutChaos:
+    """Chaos at the ``io.objstore.put`` seam (satellite): a faulted
+    part retries JUST that part byte-identically; faults past the
+    ladder abort with no partial object visible and parts swept."""
+
+    def _upload(self, data, part_bytes=1 << 14):
+        with create_stream("obj://b/chaos.bin", "w") as s:
+            s.write(data)
+
+    def test_nth_part_ioerror_retries_that_part_byte_identical(self, em):
+        data = np.random.RandomState(3).bytes(80_000)
+        objstore.configure(put_part_bytes=1 << 14, put_parallel=1)
+        set_policy("io.objstore.put",
+                   RetryPolicy(max_attempts=4, sleep=_noop_sleep))
+        inject.install("site=io.objstore.put,fault=ioerror,nth=3")
+        self._upload(data)
+        # the retry re-sent the faulted part verbatim: the assembled
+        # object is byte-identical, no part doubled or dropped
+        assert em.get("b", "chaos.bin") == data
+        assert retry_counts().get("io.objstore.put", 0) >= 1
+
+    def test_truncated_part_detected_and_resent(self, em):
+        data = np.random.RandomState(4).bytes(60_000)
+        objstore.configure(put_part_bytes=1 << 14, put_parallel=1)
+        set_policy("io.objstore.put",
+                   RetryPolicy(max_attempts=4, sleep=_noop_sleep))
+        before = _counter("objstore.put.retries")
+        inject.install("site=io.objstore.put,fault=truncate,times=2")
+        self._upload(data)
+        assert em.get("b", "chaos.bin") == data
+        assert _counter("objstore.put.retries") > before
+
+    def test_exhausted_ladder_aborts_no_partial_object(self, em):
+        data = np.random.RandomState(5).bytes(80_000)
+        objstore.configure(put_part_bytes=1 << 14, put_parallel=2)
+        set_policy("io.objstore.put",
+                   RetryPolicy(max_attempts=2, sleep=_noop_sleep))
+        before = _counter("objstore.put.aborts")
+        s = create_stream("obj://b/chaos.bin", "w")
+        s.write(data[: 1 << 14])  # spill: the multipart upload is live
+        inject.install("site=io.objstore.put,fault=ioerror,times=50")
+        with pytest.raises((IOError, OSError, DMLCError)):
+            try:
+                s.write(data[1 << 14:])
+            finally:
+                s.close()
+        # no torn object became visible, and the writer's own abort
+        # already swept its staged parts
+        with pytest.raises(FileNotFoundError):
+            em.head("b", "chaos.bin")
+        assert em.list_uploads("b") == []
+        assert _counter("objstore.put.aborts") > before
+
+    def test_single_shot_truncation_never_lands_short(self, em):
+        set_policy("io.objstore.put",
+                   RetryPolicy(max_attempts=3, sleep=_noop_sleep))
+        inject.install("site=io.objstore.put,fault=truncate,times=2")
+        with create_stream("obj://b/ss.bin", "w") as s:
+            s.write(b"Z" * 5000)
+        assert em.get("b", "ss.bin") == b"Z" * 5000
+
+    def test_sweep_reaps_dead_writer_uploads_only(self, em):
+        from dmlc_tpu.io.objstore.multipart import sweep_uploads
+        live = em.create_multipart("b", "live.bin")
+        em.put_part("b", "live.bin", live, 0, b"l")
+        dead = em.create_multipart("b", "dead.bin")
+        em.put_part("b", "dead.bin", dead, 0, b"d")
+        # re-stage the second upload under a pid that cannot be alive
+        import dmlc_tpu.io.objstore.emulator as _emu
+        inner = em if isinstance(em, _emu.EmulatedObjectStore) \
+            else em._inner
+        mpu = os.path.join(inner.root, "b", ".mpu")
+        dead_id = "p999999999-feedbeef"
+        os.rename(os.path.join(mpu, dead), os.path.join(mpu, dead_id))
+        assert sweep_uploads(em, "b") == 1
+        ids = [u for u, _ in em.list_uploads("b")]
+        assert ids == [live]
+        em.abort_multipart("b", "live.bin", live)
