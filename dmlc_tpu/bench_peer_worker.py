@@ -96,7 +96,7 @@ def main() -> int:
         raise RuntimeError("bench_peer_worker needs "
                            "launch_local(serve_ports=...)")
     from dmlc_tpu.rendezvous import install_if_env as rndv_if_env
-    rndv_if_env()     # DMLC_TPU_RNDV_URI/PORT: elastic membership
+    rndv = rndv_if_env()  # DMLC_TPU_RNDV_URI/PORT: elastic membership
     hist_if_env()     # before flight: DMLC_TPU_HISTORY_S must win
     flight_if_env()
     gang_if_env()     # DMLC_TPU_GANG_POLL_S (rank 0): /gang rollups
@@ -119,11 +119,20 @@ def main() -> int:
                 "counters": _delta(before, _counters())}
 
     # both servers must be up before any rank's cold epoch starts —
-    # and every rank must stay alive (serving) until all finished
-    _barrier(out_dir, "start", rank, world)
-    cold = epoch()
-    _barrier(out_dir, "cold", rank, world)
-    warm = epoch()
+    # and every rank must stay alive (serving) until all finished;
+    # the trace_if_env wrap makes each rank export a rank-tagged
+    # Chrome trace (launch_local(trace_dir=...)) so merged gang
+    # timelines carry the flow-linked client/server RPC span pairs
+    from dmlc_tpu.obs.trace import trace_if_env
+    with trace_if_env():
+        _barrier(out_dir, "start", rank, world)
+        cold = epoch()
+        if rndv is not None:
+            # one epoch-fenced progress beat: the traced rendezvous
+            # commit edge on the same timeline as the data plane
+            rndv.commit(f"peer-bench-{rank}", cold["bytes"])
+        _barrier(out_dir, "cold", rank, world)
+        warm = epoch()
     from dmlc_tpu.io.stream import create_stream
     with create_stream(os.path.join(out_dir, f"peer-{rank}.json"),
                        "w") as s:

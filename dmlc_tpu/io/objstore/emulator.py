@@ -48,6 +48,7 @@ import time
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
+from dmlc_tpu.obs import rpc as _rpc
 from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = ["ObjectInfo", "EmulatedObjectStore"]
@@ -116,11 +117,16 @@ class EmulatedObjectStore:
         parallel parts measurably faster than one serial upload."""
         p = self._path(bucket, key)
         os.makedirs(os.path.dirname(p), exist_ok=True)
-        tmp = p + f".tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        self._throttle(len(data))
-        os.replace(tmp, p)
+        # emulated_server models the serving half of the hop (obs.rpc):
+        # the disk write + modeled wire time IS the handle time a real
+        # endpoint would echo, so single-process benches decompose
+        # client latency exactly like wire runs
+        with _rpc.emulated_server("put"):
+            tmp = p + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            self._throttle(len(data))
+            os.replace(tmp, p)
         with self._lock:
             self.puts += 1
             self.put_bytes += len(data)
@@ -163,11 +169,12 @@ class EmulatedObjectStore:
         d = self._mpu_dir(bucket, upload_id)
         os.makedirs(d, exist_ok=True)
         p = os.path.join(d, f"part-{part_num:05d}")
-        tmp = p + f".tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        self._throttle(len(data))
-        os.replace(tmp, p)
+        with _rpc.emulated_server("put"):
+            tmp = p + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            self._throttle(len(data))
+            os.replace(tmp, p)
         with self._lock:
             self.put_parts += 1
             self.put_bytes += len(data)
@@ -310,8 +317,9 @@ class EmulatedObjectStore:
             end: Optional[int] = None) -> bytes:
         """Ranged GET: bytes ``[start, end)`` of the object (``end``
         None = to the end). Pays the latency/bandwidth model."""
-        data = self._read_range(bucket, key, start, end)
-        self._throttle(len(data))
+        with _rpc.emulated_server("get"):
+            data = self._read_range(bucket, key, start, end)
+            self._throttle(len(data))
         with self._lock:
             self.gets += 1
             self.get_bytes += len(data)
@@ -327,9 +335,10 @@ class EmulatedObjectStore:
         genuinely move fewer modeled wire bytes; the caller decodes
         under its retry seam and serves the raw range."""
         from dmlc_tpu.io.codec import encode_page
-        data = encode_page(self._read_range(bucket, key, start, end),
-                           level)
-        self._throttle(len(data))
+        with _rpc.emulated_server("get"):
+            data = encode_page(
+                self._read_range(bucket, key, start, end), level)
+            self._throttle(len(data))
         with self._lock:
             self.gets += 1
             self.get_bytes += len(data)

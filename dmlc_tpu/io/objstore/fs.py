@@ -79,6 +79,7 @@ from typing import List, Optional, Tuple
 from dmlc_tpu.io.filesys import FileInfo, FileSystem, URI
 from dmlc_tpu.io.pagestore import PageStore
 from dmlc_tpu.io.stream import MemoryStream, SeekStream, Stream
+from dmlc_tpu.obs import rpc as _rpc
 from dmlc_tpu.resilience import inject as _inject
 from dmlc_tpu.resilience.policy import guarded
 from dmlc_tpu.utils.logging import DMLCError, check
@@ -88,6 +89,12 @@ __all__ = [
     "options", "ENV_ROOT", "ENV_LATENCY", "ENV_GBPS", "ENV_ENDPOINT",
     "ENV_AUTH",
 ]
+
+def _rpc_peer(c) -> str:
+    """Edge-table peer label for a backing client: the HTTP endpoint
+    when there is one, the emulator otherwise."""
+    return getattr(c, "endpoint", None) or "emulator"
+
 
 ENV_ROOT = "DMLC_TPU_OBJSTORE_ROOT"
 ENV_LATENCY = "DMLC_TPU_OBJSTORE_LATENCY_S"
@@ -522,32 +529,40 @@ class ObjectSeekStream(SeekStream):
         want = end - start
         encoded = (self._codec_level > 0
                    and hasattr(self._c, "get_encoded"))
+        peer = _rpc_peer(self._c)
 
         def attempt():
-            if encoded:
-                wire = self._c.get_encoded(self._bucket, self._key,
-                                           start, end,
-                                           self._codec_level)
-                wire = _inject.corrupt("io.objstore.get", wire)
-                try:
-                    data = decode_page(wire)
-                except DMLCError as e:
+            # one client span per ATTEMPT (obs.rpc): the enclosing
+            # operation() pins the trace_id, so injected retries show
+            # as countable same-trace spans on the timeline
+            with _rpc.client_span("get", peer):
+                if encoded:
+                    wire = self._c.get_encoded(self._bucket, self._key,
+                                               start, end,
+                                               self._codec_level)
+                    wire = _inject.corrupt("io.objstore.get", wire)
+                    try:
+                        data = decode_page(wire)
+                    except DMLCError as e:
+                        raise IOError(
+                            f"objstore: corrupt encoded GET on "
+                            f"{self.path} [{start}, {end}): {e}"
+                        ) from e
+                else:
+                    data = _inject.corrupt(
+                        "io.objstore.get",
+                        self._c.get(self._bucket, self._key, start,
+                                    end))
+                    wire = data
+                if len(data) != want:
                     raise IOError(
-                        f"objstore: corrupt encoded GET on "
-                        f"{self.path} [{start}, {end}): {e}") from e
-            else:
-                data = _inject.corrupt(
-                    "io.objstore.get",
-                    self._c.get(self._bucket, self._key, start, end))
-                wire = data
-            if len(data) != want:
-                raise IOError(
-                    f"objstore: short ranged GET on {self.path} "
-                    f"[{start}, {end}): got {len(data)}/{want} bytes "
-                    "(truncated object or torn transfer)")
-            return wire, data
+                        f"objstore: short ranged GET on {self.path} "
+                        f"[{start}, {end}): got {len(data)}/{want} "
+                        "bytes (truncated object or torn transfer)")
+                return wire, data
 
-        wire, data = guarded("io.objstore.get", attempt)
+        with _rpc.operation("io.objstore.get", peer=peer):
+            wire, data = guarded("io.objstore.get", attempt)
         _count("get")
         _count("bytes", len(wire))
         _count("bytes_served", len(data))
@@ -655,14 +670,17 @@ class _ObjectWriteStream(Stream):
             # the writer owns the bytes: injected truncation (chaos at
             # io.objstore.put) is detected HERE and retried — a torn
             # single-shot PUT never lands short
-            data = _inject.corrupt("io.objstore.put", payload)
-            if len(data) != len(payload):
-                raise IOError(
-                    f"objstore: torn PUT on {self.path}: sent "
-                    f"{len(data)}/{len(payload)} bytes")
-            self._c.put(self._bucket, self._key, data)
+            with _rpc.client_span("put", _rpc_peer(self._c)):
+                data = _inject.corrupt("io.objstore.put", payload)
+                if len(data) != len(payload):
+                    raise IOError(
+                        f"objstore: torn PUT on {self.path}: sent "
+                        f"{len(data)}/{len(payload)} bytes")
+                self._c.put(self._bucket, self._key, data)
 
-        guarded("io.objstore.put", attempt)
+        with _rpc.operation("io.objstore.put",
+                            peer=_rpc_peer(self._c)):
+            guarded("io.objstore.put", attempt)
         _count("put")
         _count("put.bytes", len(payload))
 
@@ -702,8 +720,13 @@ class ObjectStoreFileSystem(FileSystem):
     def open_for_read(self, uri: URI) -> ObjectSeekStream:
         c = self._client()
         bucket, key = _bucket_key(uri)
-        info = guarded("io.objstore.stat",
-                       lambda: c.head(bucket, key))
+
+        def attempt():
+            with _rpc.client_span("stat", _rpc_peer(c)):
+                return c.head(bucket, key)
+
+        with _rpc.operation("io.objstore.stat", peer=_rpc_peer(c)):
+            info = guarded("io.objstore.stat", attempt)
         _count("stat")
         return ObjectSeekStream(c, self.protocol, bucket, key,
                                 size=info.size, etag=info.etag,
@@ -715,24 +738,33 @@ class ObjectStoreFileSystem(FileSystem):
         path = uri.str_uri()
 
         def stat() -> FileInfo:
-            try:
-                info = c.head(bucket, key)
-                return FileInfo(path=path, size=info.size, type="file",
-                                mtime_ns=info.mtime_ns)
-            except FileNotFoundError:
-                if c.is_prefix(bucket, key):
-                    return FileInfo(path=path, size=0, type="directory")
-                raise
+            with _rpc.client_span("stat", _rpc_peer(c)):
+                try:
+                    info = c.head(bucket, key)
+                    return FileInfo(path=path, size=info.size,
+                                    type="file",
+                                    mtime_ns=info.mtime_ns)
+                except FileNotFoundError:
+                    if c.is_prefix(bucket, key):
+                        return FileInfo(path=path, size=0,
+                                        type="directory")
+                    raise
 
-        out = guarded("io.objstore.stat", stat)
+        with _rpc.operation("io.objstore.stat", peer=_rpc_peer(c)):
+            out = guarded("io.objstore.stat", stat)
         _count("stat")
         return out
 
     def list_directory(self, uri: URI) -> List[FileInfo]:
         c = self._client()
         bucket, key = _bucket_key(uri)
-        infos = guarded("io.objstore.list",
-                        lambda: c.list(bucket, key))
+
+        def attempt():
+            with _rpc.client_span("list", _rpc_peer(c)):
+                return c.list(bucket, key)
+
+        with _rpc.operation("io.objstore.list", peer=_rpc_peer(c)):
+            infos = guarded("io.objstore.list", attempt)
         _count("list")
         return [FileInfo(path=f"{self.protocol}{bucket}/{o.key}",
                          size=o.size, type="file", mtime_ns=o.mtime_ns)

@@ -160,15 +160,33 @@ class HttpObjectStoreClient:
         """One request on a fresh connection (parallel span GETs each
         own theirs — no shared-socket state to corrupt on retry). The
         body is length-checked against ``Content-Length``: a torn
-        transfer raises here, inside the caller's retry seam."""
+        transfer raises here, inside the caller's retry seam.
+
+        Trace propagation (obs.rpc): when the calling thread holds an
+        open client span (the io.objstore.* seams open one per
+        attempt) its context rides out as the trace header and the
+        server's handle-time echo is folded back in. A thread WITHOUT
+        one — a multipart part upload on a pool thread — opens its own
+        standalone span so every wire hop stays attributable. With
+        tracing off both branches cost one global read."""
+        import contextlib as _ctx
+
+        from dmlc_tpu.obs import rpc as _rpc
         conn_cls = (http.client.HTTPSConnection
                     if self._scheme == "https"
                     else http.client.HTTPConnection)
         conn = conn_cls(self._host, self._port, timeout=self.timeout_s)
-        try:
+        with _ctx.ExitStack() as stack:
+            stack.callback(conn.close)
+            call = _rpc.active_call()
+            if call is None:
+                call = stack.enter_context(_rpc.client_span(
+                    method.lower(), f"{self._host}:{self._port}"))
             hdrs = self._headers()
             if headers:
                 hdrs.update(headers)
+            if call is not None:
+                _rpc.inject(call.ctx, hdrs)
             try:
                 conn.request(method, path, body=body, headers=hdrs)
                 resp = conn.getresponse()
@@ -181,6 +199,10 @@ class HttpObjectStoreClient:
                 raise IOError(
                     f"objstore http: {method} {path} failed mid-"
                     f"transfer: {e!r}") from e
+            if call is not None:
+                echo = resp.headers.get(_rpc.HANDLE_HEADER)
+                if echo is not None:
+                    call.note_server(echo)
             declared = resp.headers.get("Content-Length")
             if (method != "HEAD" and declared is not None
                     and declared.isdigit()
@@ -189,8 +211,6 @@ class HttpObjectStoreClient:
                     f"objstore http: torn {method} {path}: read "
                     f"{len(data)} of Content-Length {declared}")
             return resp.status, dict(resp.headers.items()), data
-        finally:
-            conn.close()
 
     @staticmethod
     def _raise_status(status: int, what: str) -> None:

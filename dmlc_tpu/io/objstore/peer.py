@@ -58,6 +58,7 @@ from typing import List, Optional
 from urllib.parse import quote
 from urllib.request import Request, urlopen
 
+from dmlc_tpu.obs import rpc as _rpc
 from dmlc_tpu.resilience import inject as _inject
 from dmlc_tpu.resilience.policy import guarded
 
@@ -205,14 +206,23 @@ class PeerTier:
             return None
         url = (f"http://{self.host}:{self.ports[index]}"
                f"/pages/{quote(entry, safe='')}")
+        peer_label = f"{self.host}:{self.ports[index]}"
         want_fp = [list(e) for e in fingerprint] if fingerprint else None
 
         def attempt() -> bytes:
             from dmlc_tpu.io.codec import decode_page
             from dmlc_tpu.utils.logging import DMLCError
-            with urlopen(Request(url), timeout=self.timeout_s) as resp:
-                raw = resp.read()
-                got_fp = resp.headers.get(FINGERPRINT_HEADER)
+            with _rpc.client_span("pages", peer_label) as call:
+                hdrs = {}
+                if call is not None:
+                    _rpc.inject(call.ctx, hdrs)
+                with urlopen(Request(url, headers=hdrs),
+                             timeout=self.timeout_s) as resp:
+                    raw = resp.read()
+                    got_fp = resp.headers.get(FINGERPRINT_HEADER)
+                    if call is not None:
+                        call.note_server(
+                            resp.headers.get(_rpc.HANDLE_HEADER))
             # chaos: a truncate clause at io.objstore.peer tears the
             # peer payload INSIDE the retried attempt, like the wire
             raw = _inject.corrupt("io.objstore.peer", raw)
@@ -240,7 +250,8 @@ class PeerTier:
             return data
 
         try:
-            data = guarded("io.objstore.peer", attempt)
+            with _rpc.operation("io.objstore.peer", peer=peer_label):
+                data = guarded("io.objstore.peer", attempt)
         except Exception:  # noqa: BLE001 — ANY failure degrades to wire
             self._note_failure(index)
             _count("miss")

@@ -61,7 +61,8 @@ class _Member:
     """One gang member's aggregation state (keyed by serve port)."""
 
     __slots__ = ("port", "rank", "ring", "gaps", "unreachable",
-                 "last_error", "last_poll_t", "polls_ok", "polls_failed")
+                 "last_error", "last_poll_t", "polls_ok", "polls_failed",
+                 "last_rpc")
 
     def __init__(self, port: int, budget_bytes: int, period_s: float):
         self.port = port
@@ -74,6 +75,9 @@ class _Member:
         self.last_poll_t: Optional[float] = None
         self.polls_ok = 0
         self.polls_failed = 0
+        # the rank's last-scraped RPC edge totals (obs.rpc collector):
+        # /gang carries the gang-wide wire-attribution picture
+        self.last_rpc: Optional[Dict[str, Any]] = None
 
     def label(self) -> str:
         return (f"rank{self.rank}" if self.rank is not None
@@ -143,6 +147,9 @@ class GangAggregator:
                 m.unreachable = False
                 m.last_error = None
                 m.last_poll_t = t
+                rpc = (snap.get("collectors") or {}).get("rpc")
+                if isinstance(rpc, dict):
+                    m.last_rpc = rpc
             m.ring.append(t, leaves)
             reachable.append(leaves)
             status[m.label()] = True
@@ -195,6 +202,7 @@ class GangAggregator:
                 "polls_ok": m.polls_ok,
                 "polls_failed": m.polls_failed,
                 "gaps": list(m.gaps),
+                "rpc": m.last_rpc,
                 "series": m.ring.to_dict(last_s=last_s),
             }
         return {

@@ -328,6 +328,21 @@ def attribute(pipeline_snap: Dict[str, Any],
                      f"{obj_payload / wall / 1e9:.3f} GB/s "
                      "wire-served)")
         evidence.append(line)
+    rpc = ((metrics or {}).get("collectors") or {}).get("rpc")
+    if isinstance(rpc, dict) and rpc.get("attributed"):
+        # the RPC edge table's wire-wait decomposition (obs.rpc):
+        # server-reported handle time vs the network+queue residual —
+        # a wire verdict names WHERE the waiting actually happened
+        server = float(rpc.get("server_us") or 0.0)
+        residual = float(rpc.get("residual_us") or 0.0)
+        attributed = server + residual
+        if attributed > 0:
+            evidence.append(
+                f"wire wait: {server / attributed:.0%} server handle, "
+                f"{residual / attributed:.0%} network+queue residual "
+                f"over {int(rpc.get('attributed', 0))} attributed "
+                f"RPCs ({int(rpc.get('count', 0))} total, "
+                f"{int(rpc.get('errors', 0))} errors)")
     ck_restore = _counter(metrics, "checkpoint.restore_bytes")
     if ck_restore:
         # the checkpoint fanout split: of the bytes restore()

@@ -29,7 +29,8 @@ import os
 from typing import Any, Dict, List, Optional
 
 from dmlc_tpu.obs.metrics import worker_rank
-from dmlc_tpu.obs.trace import TraceRecorder
+from dmlc_tpu.obs.trace import (CAT_RPC_CLIENT, CAT_RPC_SERVER,
+                                TraceRecorder)
 
 __all__ = ["chrome_events", "write_chrome", "merge_chrome_files",
            "collapsed_lines", "write_collapsed", "speedscope_doc",
@@ -46,7 +47,15 @@ def chrome_events(rec: TraceRecorder,
     shape Perfetto draws as stacked counter tracks). Metadata ("M")
     events name the process (rank-tagged when launched in a gang) and
     every recording thread.
+
+    RPC spans (cat ``rpc.client``/``rpc.server``, obs.rpc) additionally
+    emit Perfetto flow events bound by their trace_id — a flow start
+    ("s") inside the client slice and a binding flow finish ("f",
+    ``bp: "e"``) inside the server slice — so a merged gang trace draws
+    an arrow from each caller to the serving rank's handler, retries
+    included (every attempt shares the operation's trace_id).
     """
+    from dmlc_tpu.obs.rpc import TRACE_FIELD, parse as parse_ctx
     if pid is None:
         pid = os.getpid()
     rank = worker_rank()
@@ -71,6 +80,22 @@ def chrome_events(rec: TraceRecorder,
             ev["dur"] = round(dur_s * 1e6, 3)
             if args:
                 ev["args"] = args
+            if cat in (CAT_RPC_CLIENT, CAT_RPC_SERVER) and args:
+                ctx = parse_ctx(args.get(TRACE_FIELD))
+                if ctx is not None:
+                    flow: Dict[str, Any] = {
+                        "name": "rpc.flow", "cat": "rpc",
+                        "id": ctx.trace_id, "pid": pid, "tid": tid,
+                        "ts": ev["ts"],
+                    }
+                    if cat == CAT_RPC_CLIENT:
+                        flow["ph"] = "s"
+                    else:
+                        flow["ph"] = "f"
+                        flow["bp"] = "e"  # bind to enclosing slice
+                    out.append(ev)
+                    out.append(flow)
+                    continue
         elif ph == "i":
             ev["s"] = "t"  # thread-scoped instant
             if args:

@@ -47,6 +47,9 @@ Bundle layout (one timestamped dir per process under ``out_dir``)::
       control.json    # the verdict-driven controller's decision
                       # ledger + knob state (only when
                       # dmlc_tpu.obs.control is installed)
+      rpc.json        # the RPC edge table: per-(peer, verb) latency
+                      # attribution (only when dmlc_tpu.obs.rpc
+                      # recorded at least one edge)
 
 Wiring: ``install()`` / ``uninstall()`` directly, or
 :func:`install_if_env` under ``DMLC_TPU_FLIGHT_DIR`` (set per worker
@@ -331,6 +334,18 @@ class FlightRecorder:
                 wrote["control.json"] = f"failed: {e!r}"
             if control_doc is not None:
                 _write_json("control.json", control_doc)
+            # the RPC edge table: who this process was talking to and
+            # where its wire wait went, at the moment of death
+            try:
+                from dmlc_tpu.obs import rpc as _rpc
+                rpc_doc = _rpc.view()
+                if not rpc_doc.get("edges"):
+                    rpc_doc = None
+            except Exception as e:  # noqa: BLE001 — optional section
+                rpc_doc = None
+                wrote["rpc.json"] = f"failed: {e!r}"
+            if rpc_doc is not None:
+                _write_json("rpc.json", rpc_doc)
             try:
                 from dmlc_tpu.resilience import inject as _inject
                 plan = _inject.active()

@@ -80,6 +80,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from dmlc_tpu.obs import rpc as _rpc
 from dmlc_tpu.obs.metrics import (
     REGISTRY, MetricsRegistry, merge_snapshots,
 )
@@ -296,10 +297,33 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, format, *args):  # noqa: A002 — base signature
         pass  # scrapes must not spam stderr
 
+    def setup(self):
+        # arrival stamp for the server span's queue phase: everything
+        # between the connection being handed to this thread and
+        # do_GET starting (request-line/header parse included)
+        self._rpc_arrival = time.perf_counter()
+        super().setup()
+
+    def _echo_trace(self) -> None:
+        """Echo an inbound trace context plus the server handle time
+        so far (obs.rpc headers) — the client folds the echo into its
+        edge table to split wire wait from server work. Untraced
+        requests get no extra headers. Call between send_response()
+        and end_headers()."""
+        ctx = getattr(self, "_rpc_ctx", None)
+        if ctx is None:
+            return
+        self._rpc_sent = time.perf_counter()
+        self.send_header(_rpc.TRACE_HEADER, _rpc.serialize(ctx))
+        self.send_header(
+            _rpc.HANDLE_HEADER,
+            str(round((self._rpc_sent - self._rpc_t0) * 1e6, 1)))
+
     def _send(self, code: int, body: bytes, ctype: str) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        self._echo_trace()
         self.end_headers()
         self.wfile.write(body)
 
@@ -383,6 +407,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("X-Dmlc-Fingerprint", json.dumps(fp))
             self.send_header("X-Dmlc-Codec",
                              str(meta.get("codec", "raw")))
+            self._echo_trace()
             self.end_headers()
             self.wfile.write(data)
             owner.registry.counter("objstore.peer.served").inc()
@@ -393,6 +418,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         url = urlparse(self.path)
+        # bind the inbound trace context (if any): the echo headers and
+        # the server span below both key off it
+        self._rpc_ctx = _rpc.extract(self.headers)
+        self._rpc_t0 = time.perf_counter()
+        self._rpc_sent: Optional[float] = None
         try:
             owner: "StatusServer" = self.server.status_server
             if url.path == "/metrics":
@@ -521,6 +551,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                MAX_TRACE_CAPTURE_S))
                         hz = float(raw_hz) if raw_hz else None
                         self._send_json(prof.burst(seconds, hz=hz))
+            elif url.path == "/rpc":
+                self._send_json(_rpc.view())
             elif url.path.startswith("/pages/"):
                 self._serve_page(owner, url.path[len("/pages/"):])
             else:
@@ -535,6 +567,7 @@ class _Handler(BaseHTTPRequestHandler):
                                                "/control[?last=N]",
                                                "/profile?seconds=N"
                                                "&hz=M",
+                                               "/rpc",
                                                "/pages/<entry>"]},
                                 code=404)
         except Exception as e:  # noqa: BLE001 — a scrape must never
@@ -542,6 +575,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json({"error": repr(e)}, code=500)
             except Exception:  # noqa: BLE001 — client went away
                 pass
+        finally:
+            ctx = self._rpc_ctx
+            if ctx is not None:
+                t1 = time.perf_counter()
+                arrival = getattr(self, "_rpc_arrival", self._rpc_t0)
+                sent = self._rpc_sent if self._rpc_sent is not None \
+                    else t1
+                verb = url.path.lstrip("/").split("/", 1)[0] or "/"
+                _rpc.record_server_span(
+                    verb, _rpc.serialize(ctx), arrival, t1 - arrival,
+                    args={
+                        "peer": str(self.client_address[0]),
+                        "queue_us": round(
+                            (self._rpc_t0 - arrival) * 1e6, 1),
+                        "handle_us": round(
+                            (sent - self._rpc_t0) * 1e6, 1),
+                        "write_us": round((t1 - sent) * 1e6, 1),
+                    })
 
 
 class StatusServer:
@@ -703,17 +754,29 @@ def scrape(port: int, host: str = "127.0.0.1",
 
     A resilience seam (site ``obs.scrape``, fail-fast 2-attempt site
     default): one dropped connection does not mark a live rank
-    unreachable in the merged gang view."""
-    from urllib.request import urlopen
+    unreachable in the merged gang view. Each poll is a traced RPC
+    edge of its own — one operation trace_id per scrape, one client
+    span per attempt — so a slow or retried scrape shows up on the
+    gang timeline instead of silently inflating ``obs.scrape``."""
+    from urllib.request import Request, urlopen
 
     from dmlc_tpu.resilience.policy import guarded
 
     def get() -> Dict[str, Any]:
-        with urlopen(f"http://{host}:{port}{path}",
-                     timeout=timeout_s) as resp:
-            return json.load(resp)
+        with _rpc.client_span("scrape", f"{host}:{port}") as call:
+            hdrs: Dict[str, str] = {}
+            if call is not None:
+                _rpc.inject(call.ctx, hdrs)
+            with urlopen(Request(f"http://{host}:{port}{path}",
+                                 headers=hdrs),
+                         timeout=timeout_s) as resp:
+                if call is not None:
+                    call.note_server(
+                        resp.headers.get(_rpc.HANDLE_HEADER))
+                return json.load(resp)
 
-    return guarded("obs.scrape", get)
+    with _rpc.operation("obs.scrape", peer=f"{host}:{port}"):
+        return guarded("obs.scrape", get)
 
 
 def scrape_gang(ports: Optional[List[int]] = None,

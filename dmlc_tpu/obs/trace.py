@@ -40,8 +40,16 @@ __all__ = [
     "TraceRecorder", "active", "start", "stop", "trace_to",
     "trace_if_env", "span", "instant", "counter",
     "set_fallback", "clear_fallback", "fallback",
+    "CAT_RPC_CLIENT", "CAT_RPC_SERVER",
     "Profiler", "StageStats", "profiler", "jax_trace",
 ]
+
+# span categories for the cross-process RPC plane (obs.rpc): a client
+# span is one attempt observed from the calling side, a server span is
+# the serving handler's half. Both carry the serialized trace context
+# in args — obs.export matches the pair into Perfetto flow events.
+CAT_RPC_CLIENT = "rpc.client"
+CAT_RPC_SERVER = "rpc.server"
 
 # event tuples: (ph, name, cat, t_s, dur_s, tid, args)
 #   ph "X": t_s = span start (perf_counter), dur_s = duration

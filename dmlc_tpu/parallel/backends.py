@@ -14,31 +14,42 @@ from __future__ import annotations
 
 import shlex
 import subprocess
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
-from dmlc_tpu.parallel.launch import worker_envs
+from dmlc_tpu.parallel.launch import rendezvous_envs, worker_envs
 from dmlc_tpu.utils.logging import DMLCError, check
 
 __all__ = ["mpi_command", "slurm_script", "sge_script",
            "kubernetes_manifest"]
 
 
-def _rank_agnostic_envs(num_workers: int, coordinator: str) -> Dict[str, str]:
-    """worker_envs minus the per-rank ids (schedulers inject those)."""
+def _rank_agnostic_envs(num_workers: int, coordinator: str,
+                        rendezvous_addr: Optional[Tuple[str, int]] = None,
+                        rendezvous_gang: Optional[str] = None
+                        ) -> Dict[str, str]:
+    """worker_envs minus the per-rank ids (schedulers inject those),
+    plus the rendezvous contract (``DMLC_TPU_RNDV_URI/PORT/GANG``) —
+    explicit addr wins, else the submit host's own env is forwarded, so
+    scheduler-launched gangs reach the same elastic membership service
+    that launch_local/launch_ssh gangs do."""
     check(num_workers >= 1, "num_workers must be >= 1")
     envs = worker_envs(coordinator, num_workers, 0)
     envs.pop("DMLC_TPU_TASK_ID")
     envs.pop("DMLC_TASK_ID")
+    envs.update(rendezvous_envs(rendezvous_addr, rendezvous_gang))
     return envs
 
 
 def mpi_command(num_workers: int, command: Sequence[str], coordinator: str,
                 host_file: Optional[str] = None,
-                submit: bool = False) -> str:
+                submit: bool = False,
+                rendezvous_addr: Optional[Tuple[str, int]] = None,
+                rendezvous_gang: Optional[str] = None) -> str:
     """mpirun launch line (reference: mpi.py — MPI as a *launcher* only;
     data-plane comms stay XLA collectives, never MPI)."""
     # rank-dependent task id comes from the MPI rank at runtime
-    envs = _rank_agnostic_envs(num_workers, coordinator)
+    envs = _rank_agnostic_envs(num_workers, coordinator,
+                               rendezvous_addr, rendezvous_gang)
     exports = " ".join(f"-x {k}={shlex.quote(v)}" for k, v in envs.items())
     hf = f"--hostfile {shlex.quote(host_file)} " if host_file else ""
     cmd_str = " ".join(shlex.quote(c) for c in command)
@@ -58,9 +69,12 @@ def mpi_command(num_workers: int, command: Sequence[str], coordinator: str,
 
 def slurm_script(num_workers: int, command: Sequence[str], coordinator: str,
                  job_name: str = "dmlc-tpu", partition: Optional[str] = None,
-                 time_limit: str = "01:00:00") -> str:
+                 time_limit: str = "01:00:00",
+                 rendezvous_addr: Optional[Tuple[str, int]] = None,
+                 rendezvous_gang: Optional[str] = None) -> str:
     """sbatch script (reference: slurm.py). Task id = $SLURM_PROCID."""
-    envs = _rank_agnostic_envs(num_workers, coordinator)
+    envs = _rank_agnostic_envs(num_workers, coordinator,
+                               rendezvous_addr, rendezvous_gang)
     exports = "\n".join(f"export {k}={shlex.quote(v)}"
                         for k, v in envs.items())
     part = f"#SBATCH --partition={partition}\n" if partition else ""
@@ -78,9 +92,12 @@ srun bash -c {shlex.quote(inner)}
 
 
 def sge_script(num_workers: int, command: Sequence[str], coordinator: str,
-               job_name: str = "dmlc-tpu", queue: Optional[str] = None) -> str:
+               job_name: str = "dmlc-tpu", queue: Optional[str] = None,
+               rendezvous_addr: Optional[Tuple[str, int]] = None,
+               rendezvous_gang: Optional[str] = None) -> str:
     """qsub array-job script (reference: sge.py). Task id = $SGE_TASK_ID-1."""
-    envs = _rank_agnostic_envs(num_workers, coordinator)
+    envs = _rank_agnostic_envs(num_workers, coordinator,
+                               rendezvous_addr, rendezvous_gang)
     exports = "\n".join(f"export {k}={shlex.quote(v)}"
                         for k, v in envs.items())
     q = f"#$ -q {queue}\n" if queue else ""
@@ -98,11 +115,14 @@ exec {cmd_str}
 
 def kubernetes_manifest(num_workers: int, command: Sequence[str],
                         coordinator: str, image: str,
-                        job_name: str = "dmlc-tpu") -> Dict:
+                        job_name: str = "dmlc-tpu",
+                        rendezvous_addr: Optional[Tuple[str, int]] = None,
+                        rendezvous_gang: Optional[str] = None) -> Dict:
     """Indexed-completion k8s Job (reference: kubernetes.py). Task id =
     $JOB_COMPLETION_INDEX (native indexed jobs replace the reference's
     hand-rolled pod numbering)."""
-    envs = _rank_agnostic_envs(num_workers, coordinator)
+    envs = _rank_agnostic_envs(num_workers, coordinator,
+                               rendezvous_addr, rendezvous_gang)
     env_list = [{"name": k, "value": v} for k, v in envs.items()]
     index_ref = {"valueFrom": {"fieldRef": {"fieldPath":
         "metadata.annotations['batch.kubernetes.io/job-completion-index']"}}}

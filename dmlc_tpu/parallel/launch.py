@@ -37,6 +37,7 @@ __all__ = [
     "worker_envs", "ps_envs", "get_role", "init_from_env", "finalize",
     "launch_local", "launch_ssh", "get_ring", "get_tree", "get_link_map",
     "find_free_port", "find_free_ports", "merge_gang_traces", "main",
+    "rendezvous_envs",
 ]
 
 # workers that wrap their run in obs.trace.trace_if_env() export a
@@ -477,6 +478,33 @@ def merge_gang_traces(trace_dir: str,
     return out_path
 
 
+def rendezvous_envs(rendezvous_addr: Optional[Tuple[str, int]] = None,
+                    rendezvous_gang: Optional[str] = None
+                    ) -> Dict[str, str]:
+    """The rendezvous env contract (``DMLC_TPU_RNDV_URI/PORT/GANG``)
+    as a dict ready to merge into worker envs. An explicit
+    ``rendezvous_addr=(host, port)`` wins; otherwise the launcher's own
+    environment is forwarded (a membership service bound on the submit
+    host is reachable from scheduler-launched workers too); empty when
+    neither names a service. Shared by launch_ssh and every
+    parallel.backends generator so elastic membership is not a
+    local/ssh-only feature."""
+    from dmlc_tpu.rendezvous import (
+        ENV_RNDV_GANG, ENV_RNDV_PORT, ENV_RNDV_URI,
+    )
+    rndv: Dict[str, str] = {}
+    if rendezvous_addr is not None:
+        rndv[ENV_RNDV_URI] = str(rendezvous_addr[0])
+        rndv[ENV_RNDV_PORT] = str(rendezvous_addr[1])
+    elif os.environ.get(ENV_RNDV_URI) and os.environ.get(ENV_RNDV_PORT):
+        rndv[ENV_RNDV_URI] = os.environ[ENV_RNDV_URI]
+        rndv[ENV_RNDV_PORT] = os.environ[ENV_RNDV_PORT]
+    if rndv:
+        rndv[ENV_RNDV_GANG] = (rendezvous_gang
+                               or os.environ.get(ENV_RNDV_GANG, "local"))
+    return rndv
+
+
 def launch_ssh(hosts: Sequence[str], command: Sequence[str],
                coordinator: str, num_workers: Optional[int] = None,
                dry_run: bool = False,
@@ -492,20 +520,8 @@ def launch_ssh(hosts: Sequence[str], command: Sequence[str],
     ``DMLC_TPU_RNDV_URI/PORT/GANG`` environment (when set) is
     forwarded — a service bound on the submit host is reachable from
     every ssh worker, not just the local gang."""
-    from dmlc_tpu.rendezvous import (
-        ENV_RNDV_GANG, ENV_RNDV_PORT, ENV_RNDV_URI,
-    )
     n = num_workers or len(hosts)
-    rndv: Dict[str, str] = {}
-    if rendezvous_addr is not None:
-        rndv[ENV_RNDV_URI] = str(rendezvous_addr[0])
-        rndv[ENV_RNDV_PORT] = str(rendezvous_addr[1])
-    elif os.environ.get(ENV_RNDV_URI) and os.environ.get(ENV_RNDV_PORT):
-        rndv[ENV_RNDV_URI] = os.environ[ENV_RNDV_URI]
-        rndv[ENV_RNDV_PORT] = os.environ[ENV_RNDV_PORT]
-    if rndv:
-        rndv[ENV_RNDV_GANG] = (rendezvous_gang
-                               or os.environ.get(ENV_RNDV_GANG, "local"))
+    rndv = rendezvous_envs(rendezvous_addr, rendezvous_gang)
     lines = []
     for task_id in range(n):
         host = hosts[task_id % len(hosts)]

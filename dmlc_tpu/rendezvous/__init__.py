@@ -94,9 +94,27 @@ class RendezvousClient:
 
     def _call(self, payload: Dict[str, Any],
               site: str) -> Dict[str, Any]:
+        from dmlc_tpu.obs import rpc as _rpc
         from dmlc_tpu.resilience.policy import guarded
-        return guarded(site, lambda: service.call(
-            self.host, self.port, payload, timeout_s=self.timeout_s))
+        verb = site.rsplit(".", 1)[-1]
+        peer = f"{self.host}:{self.port}"
+
+        def attempt() -> Dict[str, Any]:
+            # the trace context rides the line-JSON payload itself (a
+            # "trace" field the service echoes with its handle time) —
+            # one client span per attempt under the shared trace_id
+            with _rpc.client_span(verb, peer) as call:
+                if call is not None:
+                    _rpc.inject(call.ctx, payload,
+                                key=_rpc.TRACE_FIELD)
+                resp = service.call(self.host, self.port, payload,
+                                    timeout_s=self.timeout_s)
+                if call is not None:
+                    call.note_server(resp.get(_rpc.HANDLE_FIELD))
+                return resp
+
+        with _rpc.operation(site, peer=peer):
+            return guarded(site, attempt)
 
     # -- membership ops
 

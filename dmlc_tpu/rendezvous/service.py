@@ -144,18 +144,34 @@ class _Handler(socketserver.StreamRequestHandler):
     timeout = 10.0
 
     def handle(self) -> None:  # noqa: D102 — socketserver contract
+        from dmlc_tpu.obs import rpc as _rpc
         try:
             line = self.rfile.readline(MAX_LINE + 1)
             if not line or len(line) > MAX_LINE:
                 return
+            ctx = None
+            op = "?"
+            t0 = time.perf_counter()
             try:
                 req = json.loads(line.decode("utf-8"))
                 check(isinstance(req, dict), "request must be an object")
+                # an inbound trace context (obs.rpc) rides as an extra
+                # field the op dispatch below simply ignores
+                ctx = _rpc.extract(req, key=_rpc.TRACE_FIELD)
+                op = str(req.get("op", "?"))
                 resp = self.server.rendezvous.handle(req)
             except Exception as e:  # noqa: BLE001 — one bad request
                 # must not take the accept loop down; the client sees
                 # a typed error line instead of a dropped connection
                 resp = {"ok": False, "error": repr(e)}
+            if ctx is not None:
+                dur_s = time.perf_counter() - t0
+                _rpc.inject(ctx, resp, key=_rpc.TRACE_FIELD)
+                resp[_rpc.HANDLE_FIELD] = round(dur_s * 1e6, 1)
+                _rpc.record_server_span(
+                    op, _rpc.serialize(ctx), t0, dur_s,
+                    args={"peer": str(self.client_address[0]),
+                          "handle_us": round(dur_s * 1e6, 1)})
             self.wfile.write(json.dumps(resp).encode("utf-8") + b"\n")
         except OSError:
             pass  # client went away mid-exchange; nothing to answer

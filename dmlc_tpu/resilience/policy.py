@@ -450,7 +450,15 @@ def guarded(site: str, fn: Callable[[], Any],
     def attempt() -> Any:
         live = _inject._plan
         if live is not None:
-            live.fire(site)
+            try:
+                live.fire(site)
+            except BaseException:
+                # the fault killed the attempt before its transport
+                # opened a client span — tell the tracing plane so the
+                # retry still shows as one countable span per attempt
+                from dmlc_tpu.obs import rpc as _rpc
+                _rpc.note_injected_failure(site)
+                raise
         return fn()
 
     return pol.call(site, attempt)

@@ -1014,6 +1014,45 @@ def thread_lint(paths: List[str],
     return findings
 
 
+# Trace-context wire literals are a SEAM: dmlc_tpu/obs/rpc.py is the
+# ONE home for the "X-Dmlc-Trace"/"X-Dmlc-Handle-Us" header names and
+# the serialized context format. Every other module injects/extracts
+# through rpc.inject()/rpc.extract() and the TRACE_HEADER/HANDLE_HEADER
+# constants — a hand-spelled header string would silently fork the wire
+# format the flow-linked gang timelines depend on. The list is one
+# entry and stays one entry.
+TRACE_HEADER_ALLOWED = {
+    "dmlc_tpu/obs/rpc.py",
+}
+_TRACE_HEADER_LITERALS = {"X-Dmlc-Trace", "X-Dmlc-Handle-Us"}
+
+
+def trace_header_lint(paths: List[str],
+                      trees: Optional[dict] = None) -> List[str]:
+    """The trace-header gate: the ``X-Dmlc-Trace``/``X-Dmlc-Handle-Us``
+    wire literals in dmlc_tpu/ confined to obs/rpc.py (see above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel in TRACE_HEADER_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value in _TRACE_HEADER_LITERALS:
+                findings.append(
+                    f"{rel}:{node.lineno}: {node.value!r} literal "
+                    "outside obs/rpc.py — the trace-context wire "
+                    "format is owned by dmlc_tpu.obs.rpc; use "
+                    "rpc.TRACE_HEADER/rpc.HANDLE_HEADER and the "
+                    "inject()/extract() helpers")
+    return findings
+
+
 def main() -> int:
     paths = python_files()
     findings = builtin_lint(paths)
@@ -1031,6 +1070,7 @@ def main() -> int:
     findings += http_client_lint(paths, trees)
     findings += socket_lint(paths, trees)
     findings += thread_lint(paths, trees)
+    findings += trace_header_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

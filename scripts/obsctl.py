@@ -27,6 +27,12 @@ Subcommands (all read-only; the plane stays in charge):
                  caused it, so "why is this knob at this value" is
                  answerable from the CLI; exit 2 with the server's
                  enable hint when no controller is installed;
+- ``rpc``      — a rank's ``/rpc`` RPC edge table (distributed
+                 tracing plane): per-(peer, verb) call counts and
+                 client p50/p99 latency, decomposed into
+                 server-reported handle time vs network+queue
+                 residual — "is the wire slow or is the server slow"
+                 answerable per edge from the CLI;
 - ``profile``  — a rank's ``/profile`` merged Python+native
                  flamegraph: live burst (``--seconds N --hz M``) or
                  the continuous trie, summarized as a top-frame
@@ -464,6 +470,59 @@ def cmd_tenants(args) -> int:
     return 0
 
 
+def render_rpc(doc: Dict[str, Any]) -> str:
+    """One /rpc payload -> per-(peer, verb) attribution table: where
+    each edge's client-observed latency went (server handle vs
+    network+queue residual)."""
+    edges = doc.get("edges") or []
+    hdr = ["peer", "verb", "count", "err", "cli p50us", "cli p99us",
+           "srv p50us", "srv p99us", "net p50us", "srv%"]
+    rows: List[List[str]] = []
+    for e in sorted(edges, key=lambda e: (e["peer"], e["verb"])):
+        cli = e.get("client_us") or {}
+        srv = e.get("server_us") or {}
+        net = e.get("residual_us") or {}
+        attributed = e.get("server_total_us") is not None \
+            and e.get("attributed")
+        share = "-"
+        if attributed:
+            total = (e["server_total_us"] or 0.0) \
+                + (e["residual_total_us"] or 0.0)
+            if total > 0:
+                share = f"{e['server_total_us'] / total:.0%}"
+        rows.append([
+            str(e["peer"]), str(e["verb"]), str(e["count"]),
+            str(e["errors"]), _fmt(cli.get("p50"), 0),
+            _fmt(cli.get("p99"), 0), _fmt(srv.get("p50"), 0),
+            _fmt(srv.get("p99"), 0), _fmt(net.get("p50"), 0), share,
+        ])
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows
+              else len(c) for i, c in enumerate(hdr)]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(hdr, widths))]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    lines.append("(srv% = server handle share of attributed wire "
+                 "wait; the rest is network+queue residual)")
+    return "\n".join(lines)
+
+
+def cmd_rpc(args) -> int:
+    port = _default_port(args)
+    doc = _fetch(port, "/rpc", host=args.host)
+    if "edges" not in doc:
+        print(json.dumps(doc))
+        return 2
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    if not doc["edges"]:
+        print("no RPC edges recorded yet (tracing off, or no "
+              "cross-process calls since start)")
+        return 0
+    print(render_rpc(doc))
+    return 0
+
+
 def cmd_profile(args) -> int:
     port = _default_port(args)
     qs = []
@@ -566,6 +625,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--keys", type=int, default=12,
                    help="ledger records to render in the summary")
     p.set_defaults(fn=cmd_control)
+
+    p = sub.add_parser("rpc",
+                       help="a rank's /rpc edge table (per-peer wire "
+                            "latency attribution)")
+    common(p)
+    p.set_defaults(fn=cmd_rpc)
 
     p = sub.add_parser("profile",
                        help="a rank's merged Python+native flamegraph")

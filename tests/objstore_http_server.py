@@ -26,9 +26,12 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, unquote, urlparse
+
+from dmlc_tpu.obs import rpc as _rpc
 
 _RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)$")
 
@@ -40,6 +43,26 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     # -- plumbing
+
+    def handle_one_request(self):
+        # per-request arrival stamp for the trace-context echo below
+        self._rpc_t0 = time.perf_counter()
+        super().handle_one_request()
+
+    def end_headers(self):
+        # speak the server half of the trace-context contract: echo
+        # the inbound context + our handle time, like obs/serve.py and
+        # real traced endpoints do, so client spans against this test
+        # server get server_us attribution too
+        headers = getattr(self, "headers", None)
+        trace = headers.get(_rpc.TRACE_HEADER) if headers else None
+        if trace is not None:
+            t0 = getattr(self, "_rpc_t0", time.perf_counter())
+            handle_us = (time.perf_counter() - t0) * 1e6
+            self.send_header(_rpc.TRACE_HEADER, trace)
+            self.send_header(_rpc.HANDLE_HEADER,
+                             str(round(handle_us, 1)))
+        super().end_headers()
 
     def _em(self):
         return self.server.emulator

@@ -484,3 +484,27 @@ class TestLintGate:
             path = os.path.join(lint.REPO, "dmlc_tpu",
                                 *rel.split("/"))
             assert lint.http_client_lint([path]) == [], rel
+
+    def test_trace_header_gate_clean(self):
+        # the X-Dmlc-Trace/X-Dmlc-Handle-Us wire literals live only in
+        # obs/rpc.py; everything else imports the helpers
+        findings = lint.trace_header_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_trace_header_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe13.py")
+        with open(bad, "w") as f:
+            f.write("H = 'X-Dmlc-Trace'\n"
+                    "def f(resp):\n"
+                    "    return resp.headers.get('X-Dmlc-Handle-Us')\n"
+                    "OK = 'X-Dmlc-Codec'\n")  # other headers are fine
+        try:
+            findings = lint.trace_header_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 2, "\n".join(findings)
+        assert all("obs/rpc.py" in f for f in findings)
+
+    def test_trace_header_gate_allows_rpc_module(self):
+        path = os.path.join(lint.REPO, "dmlc_tpu", "obs", "rpc.py")
+        assert lint.trace_header_lint([path]) == []
