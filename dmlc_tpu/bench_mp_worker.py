@@ -38,6 +38,7 @@ def main() -> int:
     from dmlc_tpu.obs.flight import install_if_env
     from dmlc_tpu.obs.profile import install_if_env as prof_if_env
     from dmlc_tpu.obs.serve import serve_if_env
+    from dmlc_tpu.obs.slo import install_if_env as slo_if_env
     from dmlc_tpu.obs.timeseries import install_if_env as hist_if_env
     from dmlc_tpu.obs.trace import trace_if_env
     from dmlc_tpu.pipeline.scheduler import install_if_env as sched_if_env
@@ -45,6 +46,7 @@ def main() -> int:
     serve_if_env()
     rndv_if_env()     # DMLC_TPU_RNDV_URI/PORT: elastic membership
     sched_if_env()    # DMLC_TPU_SCHED: multi-tenant scheduler
+    slo_if_env()      # DMLC_TPU_SLO: declared objectives on /slo
     hist_if_env()     # before flight: DMLC_TPU_HISTORY_S must win
     install_if_env()
     gang_if_env()     # DMLC_TPU_GANG_POLL_S (rank 0 only): /gang

@@ -76,6 +76,19 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                part-sharded corpus, reshard cost (epoch delivery →
                first post-reshard commit) and the wire bytes
                mid-epoch resume saves vs replay-from-zero in the JSON
+ 21. ckpt_restore_fanout — the checkpoint PR's acceptance arc: a
+               5-rank gang saves device-direct (parallel multipart
+               objstore PUTs) then cold-restores with peer fanout —
+               per-rank wire bytes a fraction of the checkpoint,
+               incremental saves a fraction of full
+ 22. slo_burn — the SLO PR's acceptance probe: a victim tenant
+               declares its latency SLO at admission
+               (add_tenant(slo=...)), a flush bully starves it
+               through the DRR scheduler until the SRE-workbook
+               FAST-burn pair (14.4x over W/6 and W/72) fires as an
+               slo-bound fast-burn verdict, then pause("bully")
+               clears the alert; attainment / burn / time-to-fire /
+               time-to-clear in the JSON
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 
@@ -2237,6 +2250,207 @@ def bench_ckpt_restore_fanout(mb: int) -> Dict:
             "single_shot_s": round(single_s, 3)}
 
 
+def bench_slo_burn(mb: int) -> Dict:
+    """Config 22 (the SLO PR): end-to-end burn-rate alerting on a REAL
+    two-tenant run. A latency-sensitive ``victim`` declares its SLO at
+    admission (``add_tenant(slo=...)`` — 50 ms p-batch target, 30 s
+    window, 1% budget) and a ``bully`` tenant then starves it THROUGH
+    the scheduler: the bully is provisioned flush (weight 8, pull rate
+    held under its per-round refill so it never goes broke) which pins
+    the broke victim to clock-paced DRR rounds — each victim pull
+    costs two round periods (~0.2 s), a deterministic 4x violation of
+    its target, not a load-dependent maybe. The arc asserted:
+
+      alone      — attainment healthy, no alert;
+      contended  — the FAST-burn pair (W/6, W/72 windows, 14.4x) fires
+                   within the fast_long horizon and the miss surfaces
+                   as an ``slo``-bound ``fast-burn`` verdict
+                   (obs.analyze shape — what /analyze attaches);
+      recovered  — ``pause("bully")`` returns the box to the victim
+                   and the fast alert CLEARS (the short window is the
+                   reset gate; a fired alert must not latch).
+
+    Attainment/burn per phase, time-to-fire and time-to-clear ride in
+    the JSON. The victim's latency histogram uses the SLO-aware bucket
+    bounds the declaration picked, so the judged counts come from
+    buckets pinned to the target — not log2 luck."""
+    import threading
+
+    from dmlc_tpu.obs import slo as slo_mod
+    from dmlc_tpu.pipeline import Pipeline
+    from dmlc_tpu.pipeline import scheduler as sched_mod
+
+    TARGET_S = 0.05
+    WINDOW_S = 30.0      # fast pair: 5 s / 0.42 s
+    BUDGET = 0.01        # 1% of pulls may miss
+    ALONE_S = 0.8
+    FIRE_TIMEOUT_S = 9.0
+    CLEAR_TIMEOUT_S = 12.0
+
+    victim_src = f"{_TMP}.slo.victim.libsvm"
+    bully_src = f"{_TMP}.slo.bully.libsvm"
+    victim_size = make_libsvm(victim_src, 2, seed=22)
+    bully_size = make_libsvm(bully_src, max(mb, 8), seed=23)
+
+    # this config owns BOTH planes for the run: displace any
+    # env-installed scheduler (config 19's rationale) and any
+    # env-installed SLO engine — the declaration below must land on
+    # THIS scheduler's registry, judged from a clean baseline
+    sched_mod.uninstall()
+    slo_mod.uninstall()
+    sched = sched_mod.PipelineScheduler(quantum=1.0, burst=2.0,
+                                        queue_budget=24)
+    assert sched_mod.install(sched) is sched
+    stop = threading.Event()
+    errors: List[str] = []
+    try:
+        # weight 0.2 caps the victim's pull cost at its burst
+        # allowance (0.4 credits) with a 0.2/round refill: broke under
+        # contention, every pull is TWO clock-paced rounds
+        sched.add_tenant("victim", weight=0.2,
+                         slo={"target_s": TARGET_S, "window_s": WINDOW_S,
+                              "budget": BUDGET})
+        sched.register_tenant("bully", weight=8.0)
+        eng = slo_mod.active()
+        assert eng is not None, "SLO declaration did not install"
+        obj = "tenant.victim"
+        assert obj in eng.objectives()
+
+        victim = (Pipeline.from_uri(victim_src)
+                  .parse(format="libsvm", nthreads=1)
+                  .batch(512)
+                  .build(tenant="victim"))
+        bully = (Pipeline.from_uri(bully_src)
+                 .parse(format="libsvm", nthreads=1)
+                 .batch(1024)
+                 .build(tenant="bully"))
+
+        def bully_loop():
+            try:
+                while not stop.is_set():
+                    for _ in bully:
+                        if stop.is_set():
+                            break
+                        # stay FLUSH: 8 credits/round refill at a
+                        # 0.1 s round period feeds 80 pulls/s — at
+                        # ~40/s the bully never goes broke, so it
+                        # never advances rounds itself (a broke bully
+                        # would refill the victim off-clock and melt
+                        # the deterministic starvation)
+                        time.sleep(0.025)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"bully: {e!r}")
+
+        def row() -> Dict:
+            return eng.view()["objectives"][obj]
+
+        def victim_until(pred, timeout_s: float) -> float:
+            """Pull victim batches (judging each via a fresh engine
+            sample) until pred(row) or timeout; returns elapsed."""
+            t0 = time.perf_counter()
+            it = iter(victim)
+            while time.perf_counter() - t0 < timeout_s:
+                if next(it, None) is None:
+                    it = iter(victim)
+                    continue
+                if pred(row()):
+                    break
+                time.sleep(0.02)  # the victim IS latency-sensitive
+            return time.perf_counter() - t0
+
+        t_run0 = time.perf_counter()
+        bt = threading.Thread(target=bully_loop, daemon=True,
+                              name="tenant/bully")
+        sched.pause("bully")
+        bt.start()
+
+        # --- alone: the declaration judges a healthy tenant
+        victim_until(lambda r: False, ALONE_S)
+        alone = row()
+        assert not alone["alerts"]["fast"], \
+            f"fast-burn fired with the box idle: {alone}"
+        assert alone["attainment"] is not None \
+            and alone["attainment"] >= 0.9, \
+            f"victim unhealthy ALONE (is the box overloaded?): {alone}"
+
+        # --- contended: starve through the scheduler until the fast
+        # pair fires (both windows >= 14.4x burn)
+        sched.resume("bully")
+        fire_s = victim_until(lambda r: r["alerts"]["fast"],
+                              FIRE_TIMEOUT_S)
+        contended = row()
+        assert contended["alerts"]["fast"], \
+            (f"fast-burn never fired after {FIRE_TIMEOUT_S}s of "
+             f"deterministic starvation: {contended}")
+        verdicts = eng.verdicts()
+        bands = [v["band"] for v in verdicts
+                 if v["bound"] == "slo" and v["tenant"] == "victim"]
+        assert "fast-burn" in bands, \
+            f"firing alert produced no fast-burn verdict: {verdicts}"
+
+        # --- recovered: pause the bully; the short window resets the
+        # alert (assert FAST specifically — slow may linger while the
+        # 30 s long window drains, by design)
+        sched.pause("bully")
+        clear_s = victim_until(lambda r: not r["alerts"]["fast"],
+                               CLEAR_TIMEOUT_S)
+        recovered = row()
+        assert not recovered["alerts"]["fast"], \
+            (f"fast-burn LATCHED {CLEAR_TIMEOUT_S}s after the "
+             f"contention ended: {recovered}")
+
+        stop.set()
+        # resume BEFORE joining: a paused tenant's thread is blocked
+        # inside acquire() and would never see the stop flag
+        sched.resume("bully")
+        bt.join(timeout=60)
+        assert not bt.is_alive(), "bully thread failed to quiesce"
+        assert not errors, f"bully failed: {errors}"
+
+        rows = sched.to_dict()["tenants"]
+        assert rows["victim"].get("slo"), \
+            "declared SLO missing from the /tenants row"
+        processed = sum(t["bytes"] for t in rows.values())
+        wall = time.perf_counter() - t_run0
+        victim.close()
+        bully.close()
+
+        def _phase(r: Dict) -> Dict:
+            return {"attainment": r["attainment"],
+                    "budget_remaining": r["budget_remaining"],
+                    "fast_long_burn":
+                        r["windows"]["fast_long"]["burn"],
+                    "fast_short_burn":
+                        r["windows"]["fast_short"]["burn"],
+                    "alerts": r["alerts"]}
+        return {
+            "config": "slo_burn", "bytes": processed,
+            # headline: both tenants' billed bytes over the whole
+            # alone/contended/recovered arc — context, not the point
+            "gbps": round(processed / wall / 1e9, 4),
+            "wall_s": round(wall, 3),
+            "slo": {"target_s": TARGET_S, "window_s": WINDOW_S,
+                    "budget": BUDGET},
+            "alone": _phase(alone),
+            "contended": _phase(contended),
+            "recovered": _phase(recovered),
+            "fire_s": round(fire_s, 3),
+            "clear_s": round(clear_s, 3),
+            "verdict_bands": bands,
+            "tenants": {
+                name: {k: t.get(k) for k in
+                       ("pulls", "bytes", "credit_waits",
+                        "credit_wait_s", "batch_p99_s", "slo")}
+                for name, t in rows.items()},
+            "victim_bytes": victim_size,
+            "bully_bytes": bully_size,
+        }
+    finally:
+        stop.set()
+        slo_mod.uninstall()
+        sched_mod.uninstall()
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -2260,13 +2474,14 @@ CONFIGS = {
     20: ("elastic_reshard", lambda mb, dev: bench_elastic_reshard(mb)),
     21: ("ckpt_restore_fanout",
          lambda mb, dev: bench_ckpt_restore_fanout(mb)),
+    22: ("slo_burn", lambda mb, dev: bench_slo_burn(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-21 (0 = all)")
+                    help="1-22 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",
@@ -2301,12 +2516,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     from dmlc_tpu.obs.flight import install_if_env
     from dmlc_tpu.obs.profile import install_if_env as _prof_if_env
     from dmlc_tpu.obs.serve import serve_if_env
+    from dmlc_tpu.obs.slo import install_if_env as _slo_if_env
     from dmlc_tpu.obs.timeseries import install_if_env as _hist_if_env
     from dmlc_tpu.pipeline.scheduler import (
         install_if_env as _sched_if_env,
     )
     srv = serve_if_env()
     _sched_if_env()   # DMLC_TPU_SCHED: multi-tenant scheduler
+    _slo_if_env()     # DMLC_TPU_SLO: declared objectives on /slo
     if srv is not None:
         _log(f"obs status server: http://127.0.0.1:{srv.port}/metrics")
     # history before flight: flight installs a 15 s ring only when
@@ -2342,9 +2559,13 @@ def main(argv: Optional[List[str]] = None) -> None:
             # double a multi-second three-tenant run for nothing);
             # config 20's gang lives the whole 2->3->2 arc itself —
             # warming it would run a second multi-process gang; config
-            # 21 runs two gangs (save, then a cold restore) already
+            # 21 runs two gangs (save, then a cold restore) already;
+            # config 22 manages its own alone/contended/recovered
+            # phases (a warm pass would pre-burn the error budget the
+            # measured run asserts on)
             if not args.cold and n not in (7, 8, 9, 10, 11, 13, 14,
-                                           15, 16, 17, 18, 19, 20, 21):
+                                           15, 16, 17, 18, 19, 20,
+                                           21, 22):
                 fn(args.mb, args.device)  # warm imports + page cache
             trace_path = None
             if args.trace:

@@ -52,8 +52,11 @@ MAX_GAPS = 64
 # per-rank series still carry them; obsctl gang reads those).
 # Control collectors may be name-suffixed ("control#2" when two
 # controllers coexist), so their knob leaves are matched by the pair
-# below, not a plain prefix.
-_ROLLUP_SKIP_SECTIONS = ("collectors.pipeline.knobs",)
+# below, not a plain prefix. SLO rows are ratios/specs — summing
+# attainments across ranks is meaningless; the dedicated merged
+# ``slo`` section on view() carries the count-level merge instead.
+_ROLLUP_SKIP_SECTIONS = ("collectors.pipeline.knobs",
+                         "collectors.slo")
 _ROLLUP_SKIP_PAIRS = (("collectors.control", ".knobs."),)
 
 
@@ -62,7 +65,7 @@ class _Member:
 
     __slots__ = ("port", "rank", "ring", "gaps", "unreachable",
                  "last_error", "last_poll_t", "polls_ok", "polls_failed",
-                 "last_rpc")
+                 "last_rpc", "last_slo")
 
     def __init__(self, port: int, budget_bytes: int, period_s: float):
         self.port = port
@@ -78,6 +81,9 @@ class _Member:
         # the rank's last-scraped RPC edge totals (obs.rpc collector):
         # /gang carries the gang-wide wire-attribution picture
         self.last_rpc: Optional[Dict[str, Any]] = None
+        # the rank's last-scraped SLO objective rows (obs.slo
+        # collector): rank 0 judges gang objectives on merged counts
+        self.last_slo: Optional[Dict[str, Any]] = None
 
     def label(self) -> str:
         return (f"rank{self.rank}" if self.rank is not None
@@ -150,6 +156,9 @@ class GangAggregator:
                 rpc = (snap.get("collectors") or {}).get("rpc")
                 if isinstance(rpc, dict):
                     m.last_rpc = rpc
+                slo = (snap.get("collectors") or {}).get("slo")
+                if isinstance(slo, dict):
+                    m.last_slo = slo
             m.ring.append(t, leaves)
             reachable.append(leaves)
             status[m.label()] = True
@@ -203,9 +212,10 @@ class GangAggregator:
                 "polls_failed": m.polls_failed,
                 "gaps": list(m.gaps),
                 "rpc": m.last_rpc,
+                "slo": m.last_slo,
                 "series": m.ring.to_dict(last_s=last_s),
             }
-        return {
+        out = {
             "schema": GANG_SCHEMA,
             "period_s": self.period_s,
             "host": self.host,
@@ -214,6 +224,21 @@ class GangAggregator:
             "ranks": ranks,
             "rollup": self._rollup.to_dict(last_s=last_s),
         }
+        slo_views = [m.last_slo for m in members
+                     if isinstance(m.last_slo, dict)]
+        if slo_views:
+            # gang-level objectives judged on MERGED window counts;
+            # unreachable ranks flag the section incomplete rather
+            # than silently skewing the attainment
+            try:
+                from dmlc_tpu.obs import slo as _slo
+                out["slo"] = _slo.merge_views(
+                    slo_views,
+                    unreachable=[m.label() for m in members
+                                 if m.unreachable])
+            except Exception:  # noqa: BLE001 — rollup must not kill
+                pass           # the /gang read
+        return out
 
     # -- lifecycle
 

@@ -55,9 +55,10 @@ import hashlib as _hashlib
 import json
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["attribute", "compare", "compare_files", "load_bench",
-           "diagnose_bench", "gauge_band", "VERDICT_KEYS", "BOUNDS",
-           "ANALYSIS_SCHEMA", "DEFAULT_TOLERANCE"]
+__all__ = ["attribute", "slo_verdict", "compare", "compare_files",
+           "load_bench", "diagnose_bench", "gauge_band",
+           "VERDICT_KEYS", "BOUNDS", "ANALYSIS_SCHEMA",
+           "DEFAULT_TOLERANCE"]
 
 # bump when the verdict's top-level shape changes incompatibly
 # (2: hot_frames — sampling-profiler function-level evidence;
@@ -75,7 +76,11 @@ VERDICT_KEYS = ("schema", "epoch", "verdict_id", "tenant", "bound",
                 "stage_waits")
 
 BOUNDS = ("parse", "assemble", "xfer", "wire", "credit-limited",
-          "consumer")
+          "consumer",
+          # a declared objective burning its error budget (obs.slo) —
+          # not a stage, but it rides the same verdict contract so the
+          # Controller can consume it without a second shape
+          "slo")
 
 # in-band delta tolerated before compare() flags a regression: the
 # BENCH_r0* archive shows ~±12% sustained-rate spread across same-band
@@ -456,6 +461,53 @@ def attribute(pipeline_snap: Dict[str, Any],
         "evidence": evidence,
         "hot_frames": hot,
         "stage_waits": stage_waits,
+    }
+
+
+def slo_verdict(name: str, row: Dict[str, Any],
+                epoch: Optional[int] = None) -> Dict[str, Any]:
+    """A burning SLO as a verdict: called by ``SloEngine.verdicts()``
+    for each objective whose fast/slow burn alert fires, so budget
+    burn rides the same ``/analyze`` → Controller → ledger path as
+    stage attribution (this PR ships the verdict; knob moves on it are
+    a later PR's). ``row`` is one objective row from
+    ``SloEngine.view()``; bound is always ``slo``, band names WHICH
+    alert (``fast-burn`` / ``slow-burn``)."""
+    alerts = row.get("alerts") or {}
+    band = "fast-burn" if alerts.get("fast") else "slow-burn"
+    windows = row.get("windows") or {}
+    long_total = int((windows.get("long") or {}).get("total") or 0)
+    # confidence scales with how many observations back the judgment
+    confidence = ("high" if long_total >= 100
+                  else "medium" if long_total >= 10 else "low")
+    evidence = [
+        f"objective {name}: {row.get('metric')} <= "
+        f"{row.get('target_s')}s over {row.get('window_s')}s, "
+        f"budget {row.get('budget')}",
+        f"budget_remaining {row.get('budget_remaining')} "
+        f"(attainment {row.get('attainment')})",
+    ]
+    for label in ("long", "short", "fast_long", "fast_short"):
+        w = windows.get(label) or {}
+        evidence.append(
+            f"{label} {w.get('window_s')}s: burn {w.get('burn')} "
+            f"({w.get('good')}/{w.get('total')} good)")
+    epoch = int(epoch or 0)
+    tenant = row.get("tenant") or name
+    digest = _hashlib.sha256(json.dumps(
+        [epoch, tenant, "slo", band, evidence],
+        sort_keys=True).encode()).hexdigest()[:10]
+    return {
+        "schema": ANALYSIS_SCHEMA,
+        "epoch": epoch,
+        "verdict_id": f"v{epoch}-{digest}",
+        "tenant": tenant,
+        "bound": "slo",
+        "band": band,
+        "confidence": confidence,
+        "evidence": evidence,
+        "hot_frames": [],
+        "stage_waits": {},
     }
 
 

@@ -50,6 +50,9 @@ Bundle layout (one timestamped dir per process under ``out_dir``)::
       rpc.json        # the RPC edge table: per-(peer, verb) latency
                       # attribution (only when dmlc_tpu.obs.rpc
                       # recorded at least one edge)
+      slo.json        # declared SLO objectives judged at dump time:
+                      # attainment, budget remaining, burn alerts
+                      # (only when dmlc_tpu.obs.slo has objectives)
 
 Wiring: ``install()`` / ``uninstall()`` directly, or
 :func:`install_if_env` under ``DMLC_TPU_FLIGHT_DIR`` (set per worker
@@ -346,6 +349,20 @@ class FlightRecorder:
                 wrote["rpc.json"] = f"failed: {e!r}"
             if rpc_doc is not None:
                 _write_json("rpc.json", rpc_doc)
+            # declared objectives at the moment of death: was the
+            # process keeping its promises when it went down, and
+            # which budget was burning
+            try:
+                from dmlc_tpu.obs import slo as _slo
+                eng = _slo.active()
+                slo_doc = (eng.view()
+                           if eng is not None and eng.objectives()
+                           else None)
+            except Exception as e:  # noqa: BLE001 — optional section
+                slo_doc = None
+                wrote["slo.json"] = f"failed: {e!r}"
+            if slo_doc is not None:
+                _write_json("slo.json", slo_doc)
             try:
                 from dmlc_tpu.resilience import inject as _inject
                 plan = _inject.active()

@@ -87,21 +87,47 @@ class Gauge:
 
 
 class Histogram:
-    """Log2-bucketed distribution summary (count/sum/min/max + bucket
-    counts keyed by upper bound). Cheap enough for per-pull waits."""
+    """Bucketed distribution summary (count/sum/min/max + bucket counts
+    keyed by upper bound). Default buckets are log2-doubling from 1e-6
+    — cheap enough for per-pull waits; pass explicit ``bounds`` (sorted
+    positive upper bounds, e.g. obs.slo.latency_bounds) when judgment
+    accuracy at a specific value matters more than range: observations
+    past the last bound land in a ``float("inf")`` overflow bucket."""
 
-    __slots__ = ("_lock", "count", "total", "min", "max", "_buckets")
+    __slots__ = ("_lock", "count", "total", "min", "max", "_buckets",
+                 "_bounds", "_lower")
 
-    def __init__(self):
+    def __init__(self, bounds: Optional[List[float]] = None):
         self._lock = threading.Lock()
         self.count = 0
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self._buckets: Dict[float, int] = {}
+        if bounds is not None:
+            bounds = [float(b) for b in bounds]
+            if (not bounds or bounds[0] <= 0
+                    or any(b >= a for b, a in zip(bounds, bounds[1:]))):
+                raise ValueError(
+                    "Histogram bounds must be positive and strictly "
+                    f"increasing, got {bounds!r}")
+            self._bounds: Optional[List[float]] = bounds
+            # per-bucket lower edge for quantile interpolation (log2
+            # buckets derive it as ub/2; explicit bounds can't)
+            self._lower: Optional[Dict[float, float]] = {
+                ub: (bounds[i - 1] if i else 0.0)
+                for i, ub in enumerate(bounds)}
+            self._lower[float("inf")] = bounds[-1]
+        else:
+            self._bounds = None
+            self._lower = None
 
-    @staticmethod
-    def _bucket(v: float) -> float:
+    def _bucket(self, v: float) -> float:
+        if self._bounds is not None:
+            for ub in self._bounds:
+                if v <= ub:
+                    return ub
+            return float("inf")
         if v <= 0:
             return 0.0
         b = 1e-6
@@ -131,8 +157,16 @@ class Histogram:
             prev = cum
             cum += n
             if cum >= target:
-                lo = 0.0 if ub <= 0 else ub / 2.0
-                est = lo + (ub - lo) * ((target - prev) / n)
+                if self._lower is not None:
+                    lo = self._lower.get(ub, 0.0)
+                else:
+                    lo = 0.0 if ub <= 0 else ub / 2.0
+                if ub == float("inf"):
+                    # overflow bucket has no upper edge to interpolate
+                    # toward; the observed max is the best estimate
+                    est = self.max if self.max is not None else lo
+                else:
+                    est = lo + (ub - lo) * ((target - prev) / n)
                 if self.min is not None:
                     est = max(est, self.min)
                 if self.max is not None:
@@ -195,9 +229,24 @@ class MetricsRegistry:
         with self._lock:
             return self._gauges.setdefault(name, Gauge())
 
-    def histogram(self, name: str) -> Histogram:
+    def histogram(self, name: str,
+                  bounds: Optional[List[float]] = None) -> Histogram:
+        """Get-or-create. ``bounds`` applies only when this call
+        CREATES the histogram — an existing instrument keeps its
+        buckets (re-bucketing live counts would corrupt them), so
+        declare bounds before the first observation."""
         with self._lock:
-            return self._histograms.setdefault(name, Histogram())
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(bounds=bounds)
+            return h
+
+    def peek_histogram(self, name: str) -> Optional[Histogram]:
+        """The histogram if it exists, else None — a reader (e.g. the
+        SLO engine judging a declared metric) must never materialize
+        an empty instrument onto /metrics."""
+        with self._lock:
+            return self._histograms.get(name)
 
     # -- collectors (the existing stats() surfaces)
 

@@ -55,7 +55,7 @@ __all__ = [
     "TRACE_HEADER", "HANDLE_HEADER", "TRACE_FIELD", "HANDLE_FIELD",
     "operation", "client_span", "active_call", "emulated_server",
     "record_server_span", "note_injected_failure",
-    "RpcEdgeTable", "EDGES", "view",
+    "RpcEdgeTable", "EDGES", "view", "membership_changed",
     "RPC_SCHEMA",
 ]
 
@@ -384,6 +384,22 @@ class RpcEdgeTable:
                 "server_us": round(server, 1),
                 "residual_us": round(residual, 1)}
 
+    def retire(self, peers) -> int:
+        """Drop every row for the given peers (each edge key is
+        ``(peer, verb)``; all verbs go). Called when rendezvous
+        membership advances past a member — a departed rank's edges
+        would otherwise sit in the bounded table forever, crowding out
+        live peers and haunting ``obsctl rpc`` and the ``/gang``
+        rollup. Returns the number of rows dropped."""
+        peers = {str(p) for p in peers}
+        if not peers:
+            return 0
+        with self._lock:
+            dead = [k for k in self._edges if k[0] in peers]
+            for k in dead:
+                del self._edges[k]
+        return len(dead)
+
     def reset(self) -> None:
         with self._lock:
             self._edges.clear()
@@ -397,3 +413,29 @@ REGISTRY.register("rpc", EDGES, RpcEdgeTable.stats)
 def view() -> Dict[str, Any]:
     """The process edge table as the ``/rpc`` document."""
     return EDGES.view()
+
+
+# peers seen in the last rendezvous roster — retirement only ever
+# touches addresses that WERE gang members, so the rendezvous service
+# endpoint, the "other" overflow bucket, and emulator rows survive
+# every membership change
+_roster_peers: set = set()
+
+
+def membership_changed(view: Dict[str, Any]) -> int:
+    """Rendezvous hook (called from ``_on_membership_change``): diff
+    the new roster against the last one and retire edges for departed
+    members. Counts retired rows on ``rpc.edges_retired``."""
+    global _roster_peers
+    live = set()
+    for entry in (view.get("roster") or []):
+        host = entry.get("host")
+        port = entry.get("port")
+        if host is not None and port is not None:
+            live.add(f"{host}:{port}")
+    departed = _roster_peers - live
+    _roster_peers = live
+    n = EDGES.retire(departed) if departed else 0
+    if n:
+        REGISTRY.counter("rpc.edges_retired").inc(n)
+    return n

@@ -36,9 +36,14 @@ rank serves:
   credits/deficit, queue share and occupancy, batch p50/p99, streaming
   watermark, last bound verdict (404 with an enable hint until a
   scheduler is installed, like ``/history``);
+- ``GET /slo`` — declared objectives judged live
+  (:mod:`dmlc_tpu.obs.slo`): per-objective windowed attainment,
+  error-budget remaining, and multi-rate burn alerts (404 with an
+  enable hint until an objective is registered, like ``/history``);
 - ``GET /analyze`` — a bottleneck-attribution verdict
   (:mod:`dmlc_tpu.obs.analyze`) over the last completed pipeline
-  epoch's stage stats + the current registry snapshot;
+  epoch's stage stats + the current registry snapshot; any FIRING
+  SLO alerts ride along as ``slo_verdicts``;
 - ``GET /control[?last=N]`` — the verdict-driven controller's state
   and decision ledger (:mod:`dmlc_tpu.obs.control`): every knob move,
   freeze, and no-op with the verdict evidence that caused it (404
@@ -518,15 +523,44 @@ class _Handler(BaseHTTPRequestHandler):
                         code=404)
                 else:
                     self._send_json(sched.to_dict())
+            elif url.path == "/slo":
+                from dmlc_tpu.obs import slo as _slo
+                eng = _slo.active()
+                if eng is None or not eng.objectives():
+                    self._send_json(
+                        {"error": "no SLO objectives registered",
+                         "hint": "set DMLC_TPU_SLO (launch_local"
+                                 "(slo=...)), declare via "
+                                 "scheduler.add_tenant(slo=...), or "
+                                 "call obs.slo.install().register()"},
+                        code=404)
+                else:
+                    self._send_json(eng.view())
             elif url.path == "/analyze":
                 verdict = owner.analyze_verdict()
-                if verdict is None:
+                # a burning declared objective rides along: the stage
+                # verdict says WHERE time goes, the slo verdicts say
+                # which promises that breaks (obs.slo)
+                svs = []
+                try:
+                    from dmlc_tpu.obs import slo as _slo
+                    eng = _slo.active()
+                    if eng is not None:
+                        svs = eng.verdicts()
+                except Exception:  # noqa: BLE001
+                    svs = []
+                if verdict is None and not svs:
                     self._send_json(
                         {"error": "no pipeline stats to attribute "
                                   "(no registered pipeline collector "
                                   "has completed an epoch yet)"},
                         code=404)
+                elif verdict is None:
+                    self._send_json({"slo_verdicts": svs})
                 else:
+                    if svs:
+                        verdict = dict(verdict)
+                        verdict["slo_verdicts"] = svs
                     self._send_json(verdict)
             elif url.path == "/profile":
                 from dmlc_tpu.obs import profile as _prof
@@ -562,7 +596,7 @@ class _Handler(BaseHTTPRequestHandler):
                                                "/healthz", "/stacks",
                                                "/trace?seconds=N",
                                                "/history", "/gang",
-                                               "/tenants",
+                                               "/tenants", "/slo",
                                                "/analyze",
                                                "/control[?last=N]",
                                                "/profile?seconds=N"

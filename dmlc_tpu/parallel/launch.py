@@ -60,6 +60,8 @@ ENV_CONTROL = "DMLC_TPU_CONTROL"          # verdict-driven controller
 #   (launch_local(control=True); obs.control.install_if_env())
 ENV_SCHED = "DMLC_TPU_SCHED"              # multi-tenant scheduler
 #   (launch_local(scheduler=...); pipeline.scheduler.install_if_env())
+ENV_SLO = "DMLC_TPU_SLO"                  # declared SLO objectives
+#   (launch_local(slo=...); obs.slo.install_if_env())
 # resilience contracts (dmlc_tpu.resilience): launch_local(faults=...)
 # sets DMLC_TPU_FAULTS for every member; the gang supervisor sets
 # DMLC_TPU_ATTEMPT (alias DMLC_NUM_ATTEMPT — the reference's rejoin
@@ -208,6 +210,7 @@ def launch_local(num_workers: int, command: Sequence[str],
                  profile_hz: Optional[float] = None,
                  control: Optional[bool] = None,
                  scheduler=None,
+                 slo=None,
                  restart_policy=None,
                  faults=None,
                  rendezvous: bool = False,
@@ -307,6 +310,17 @@ def launch_local(num_workers: int, command: Sequence[str],
     thread/queue budgets across tenants (``Pipeline.build(tenant=...)``)
     with DRR pull credits, admission control, and per-tenant rows at
     ``/tenants`` (rendered by ``obsctl tenants``).
+
+    ``slo=True`` (or a ``DMLC_TPU_SLO`` declaration string such as
+    ``"name=ingest,metric=tenant.ingest.batch_s,target=0.15"``) hands
+    every worker the SLO contract (:mod:`dmlc_tpu.obs.slo`): workers
+    that call ``obs.slo.install_if_env()`` judge declared objectives
+    live — windowed attainment, error-budget remaining, and
+    fast/slow burn alerts at ``/slo`` (rendered by ``obsctl slo``),
+    rolled up gang-wide on rank 0's ``/gang``, attached to flight
+    bundles as ``slo.json``, and surfaced as ``slo`` verdicts on
+    ``/analyze``. Tenants can also declare objectives through the
+    scheduler string (``scheduler="slo.victim=0.15:300:0.01"``).
 
     ``rendezvous=True`` makes the gang ELASTIC (docs/rendezvous.md):
     the launcher starts a :class:`dmlc_tpu.rendezvous.RendezvousService`
@@ -423,6 +437,8 @@ def launch_local(num_workers: int, command: Sequence[str],
         if scheduler:
             wenv[ENV_SCHED] = (scheduler if isinstance(scheduler, str)
                                else "1")
+        if slo:
+            wenv[ENV_SLO] = (slo if isinstance(slo, str) else "1")
         if ps_root is not None:
             wenv.update(ps_envs(ps_root[0], ps_root[1], num_workers,
                                 num_servers, "worker", task_id))

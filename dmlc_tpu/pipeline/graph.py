@@ -967,12 +967,16 @@ class CompiledPipeline:
             # pull credit FIRST (a credit-blocked tenant stops pulling
             # — its bounded queues fill and the throttle propagates up
             # to its readers), then bills its latency + volume to the
-            # tenant's accounting
+            # tenant's accounting. The billed latency is the
+            # tenant-EXPERIENCED wait — credit wait included — because
+            # that is what a declared latency SLO (obs.slo) judges: a
+            # credit-starved tenant is missing its objective even when
+            # its pipeline produces instantly
             from dmlc_tpu.pipeline.stats import _item_stats
             gen = _probed(self._runners[-1])
             while True:
-                sched.acquire(self.tenant)
                 tb = time.perf_counter()
+                sched.acquire(self.tenant)
                 item = next(gen, _END)
                 if item is _END:
                     break

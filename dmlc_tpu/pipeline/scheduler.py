@@ -58,6 +58,7 @@ pipeline and routes every delivered batch through
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 import weakref
@@ -72,7 +73,9 @@ __all__ = ["PipelineScheduler", "AdmissionError", "active", "install",
            "TENANTS_SCHEMA"]
 
 # env contract (parallel.launch.launch_local(scheduler=...) sets it):
-# "1" installs defaults; "quantum=4,queue=48,burst=2" overrides
+# "1" installs defaults; "quantum=4,queue=48,burst=2" overrides, plus
+# per-tenant SLO declarations as "slo.<tenant>=<target>[:<window>
+# [:<budget>]]" (e.g. "quantum=2,slo.victim=0.15:300:0.01")
 ENV_SCHED = "DMLC_TPU_SCHED"
 
 # bump when to_dict()'s top-level shape changes incompatibly
@@ -96,11 +99,14 @@ class _Tenant:
                  "deficit", "demand", "last_demand", "paused", "pulls",
                  "rows", "bytes", "credit_waits", "credit_wait_s",
                  "admitted", "rejected", "queued", "queue_share",
-                 "last_snapshot", "last_verdict")
+                 "last_snapshot", "last_verdict", "slo")
 
     def __init__(self, name: str, weight: float, max_pipelines: int,
                  admission: str):
         self.name = name
+        # the tenant's declared latency objective spec (None until
+        # register_tenant(slo=...) declares one)
+        self.slo: Optional[Dict[str, Any]] = None
         self.weight = weight
         self.max_pipelines = max_pipelines
         self.admission = admission
@@ -175,26 +181,89 @@ class PipelineScheduler:
 
     def register_tenant(self, name: str, *, weight: float = 1.0,
                         max_pipelines: int = 4,
-                        admission: str = "reject") -> str:
+                        admission: str = "reject",
+                        slo: Any = None) -> str:
         """Create (or re-weight) a tenant. ``admission`` is the
         over-budget policy for :meth:`admit`: "reject" raises
-        :class:`AdmissionError`, "queue" blocks until a slot frees."""
+        :class:`AdmissionError`, "queue" blocks until a slot frees.
+
+        ``slo`` declares the tenant's batch-latency objective — a
+        float target in seconds, or a dict with ``target_s`` (or
+        ``target``) plus optional ``window_s``/``budget`` — judged
+        live by :mod:`dmlc_tpu.obs.slo` over the tenant's existing
+        ``tenant.<name>.batch_s`` histogram (ROADMAP item 2's
+        "declare a target instead of hand-tuning a weight"; this PR
+        ships the judgment, a later one moves knobs on it). Declaring
+        also gives the histogram SLO-aware bucket bounds, so
+        attainment at the target is judged exactly — declare BEFORE
+        the tenant's first batch."""
         check(weight > 0, f"tenant {name!r}: weight must be > 0")
         check(max_pipelines >= 1,
               f"tenant {name!r}: max_pipelines must be >= 1")
         check(admission in ("reject", "queue"),
               f"tenant {name!r}: admission must be 'reject' or 'queue'")
+        spec = self._slo_spec(name, slo) if slo is not None else None
         with self._cond:
             t = self._tenants.get(name)
             if t is None:
-                self._tenants[name] = _Tenant(name, weight,
-                                              max_pipelines, admission)
+                t = self._tenants[name] = _Tenant(
+                    name, weight, max_pipelines, admission)
             else:
                 t.weight = weight
                 t.max_pipelines = max_pipelines
                 t.admission = admission
+            if spec is not None:
+                t.slo = spec
             self._rebalance_locked()
+        if spec is not None:
+            self._declare_slo(name, spec)
         return name
+
+    # ISSUE-19 naming: tenants DECLARE objectives at admission time
+    add_tenant = register_tenant
+
+    @staticmethod
+    def _slo_spec(name: str, slo: Any) -> Dict[str, Any]:
+        """Normalize the ``slo=`` shorthand (float target, or a dict
+        with target/window/budget) into the obs.slo register() spec."""
+        if isinstance(slo, (int, float)):
+            slo = {"target_s": float(slo)}
+        check(isinstance(slo, dict),
+              f"tenant {name!r}: slo must be a target (seconds) or a "
+              f"dict, got {type(slo).__name__}")
+        spec: Dict[str, Any] = {}
+        target = slo.get("target_s", slo.get("target"))
+        check(target is not None and float(target) > 0,
+              f"tenant {name!r}: slo needs a positive 'target_s'")
+        spec["target_s"] = float(target)
+        if slo.get("window_s") is not None:
+            spec["window_s"] = float(slo["window_s"])
+        if slo.get("budget") is not None:
+            spec["budget"] = float(slo["budget"])
+        unknown = set(slo) - {"target_s", "target", "window_s",
+                              "budget"}
+        check(not unknown,
+              f"tenant {name!r}: unknown slo keys {sorted(unknown)}")
+        return spec
+
+    def _declare_slo(self, name: str, spec: Dict[str, Any]) -> None:
+        """Register the tenant's objective with the SLO engine. Order
+        matters: the SLO-aware bounded histogram is created FIRST so
+        the engine's baseline sample sees the bucketing the judgment
+        will use (bounds apply only at creation — an already-observed
+        histogram keeps its buckets, and the judgment error is then
+        bounded by one log2 bucket width instead of zero)."""
+        from dmlc_tpu.obs import slo as _slo
+        self._registry.histogram(f"tenant.{name}.batch_s",
+                                 bounds=_slo.latency_bounds(
+                                     spec["target_s"]))
+        eng = _slo.active()
+        if eng is None:
+            eng = _slo.install(registry=self._registry)
+        objective = re.sub(r"[^a-z0-9_.\-]", "_",
+                           f"tenant.{name}".lower())
+        eng.register(objective, metric=f"tenant.{name}.batch_s",
+                     tenant=name, **spec)
 
     def tenants(self) -> List[str]:
         with self._cond:
@@ -483,6 +552,9 @@ class PipelineScheduler:
         row["batch_p50_s"] = s.get("p50")
         row["batch_p99_s"] = s.get("p99")
         row["batches"] = s.get("count")
+        if t.slo is not None:
+            # the declared objective (judged live on GET /slo)
+            row["slo"] = dict(t.slo)
         # live queue occupancy + streaming watermark off the tenant's
         # admitted pipelines (weak reads; a dead ref just drops out)
         occ = []
@@ -586,12 +658,15 @@ def uninstall() -> None:
 
 def install_if_env() -> Optional[PipelineScheduler]:
     """Gang-worker hook: install under ``DMLC_TPU_SCHED`` — "1"/"true"
-    for defaults, or "quantum=4,queue=48,burst=2" overrides — else
-    no-op (launch_local(scheduler=...) sets the var per worker)."""
+    for defaults, or "quantum=4,queue=48,burst=2" overrides, plus
+    ``slo.<tenant>=<target>[:<window>[:<budget>]]`` per-tenant SLO
+    declarations — else no-op (launch_local(scheduler=...) sets the
+    var per worker)."""
     raw = os.environ.get(ENV_SCHED, "").strip()
     if not raw or raw in ("0", "false"):
         return None
     opts: Dict[str, Any] = {}
+    slos: Dict[str, Dict[str, Any]] = {}
     if raw not in ("1", "true"):
         try:
             for part in raw.split(","):
@@ -603,13 +678,38 @@ def install_if_env() -> Optional[PipelineScheduler]:
                     opts["queue_budget"] = int(v)
                 elif k == "burst":
                     opts["burst"] = float(v)
+                elif k.startswith("slo.") and k[len("slo."):]:
+                    # slo.<tenant>=<target>[:<window>[:<budget>]]
+                    fields = v.split(":")
+                    if not 1 <= len(fields) <= 3:
+                        raise ValueError(v)
+                    spec: Dict[str, Any] = {
+                        "target_s": float(fields[0])}
+                    if len(fields) > 1:
+                        spec["window_s"] = float(fields[1])
+                    if len(fields) > 2:
+                        spec["budget"] = float(fields[2])
+                    slos[k[len("slo."):]] = spec
                 else:
                     raise ValueError(k)
         except ValueError:
             from dmlc_tpu.obs.log import warn_once
             warn_once("sched-env-malformed",
                       f"scheduler: malformed {ENV_SCHED}={raw!r} "
-                      "(want '1' or 'quantum=4,queue=48,burst=2'); "
+                      "(want '1' or 'quantum=4,queue=48,burst=2"
+                      ",slo.victim=0.15:300:0.01'); "
                       "installing defaults", all_ranks=True)
             opts = {}
-    return install(**opts)
+            slos = {}
+    sched = install(**opts)
+    for tenant, spec in slos.items():
+        try:
+            sched.register_tenant(tenant, slo=spec)
+        except DMLCError as e:
+            from dmlc_tpu.obs.log import warn_once
+            warn_once("sched-env-slo-rejected",
+                      f"scheduler: {ENV_SCHED} slo.{tenant} rejected "
+                      f"({e}); tenant registered without an objective",
+                      all_ranks=True)
+            sched.register_tenant(tenant)
+    return sched

@@ -305,6 +305,13 @@ class RendezvousClient:
         except Exception:  # noqa: BLE001
             pass
         view = self.view()
+        try:
+            # retire RPC edge rows for departed members — a dead
+            # rank's latency attribution must not haunt /rpc forever
+            from dmlc_tpu.obs import rpc as _rpc_mod
+            _rpc_mod.membership_changed(view)
+        except Exception:  # noqa: BLE001
+            pass
         for fn in list(self._callbacks):
             try:
                 fn(view)

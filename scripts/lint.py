@@ -60,6 +60,13 @@ without the tools baked in:
   HTTP elsewhere would bypass the ``io.objstore.*``/``obs.scrape``
   retry seams, fault plans, and byte counters (the ``http.server``
   side is pinned to ``obs/serve.py`` by the metric gate).
+- **SLO gate** (always run, AST-based): instruments named in the
+  ``slo.*`` family and the burn-rate threshold floats (14.4 / 6.0)
+  are confined to ``obs/slo.py`` — one home for the alert math; every
+  other surface imports ``FAST_BURN_RATE``/``SLOW_BURN_RATE`` and
+  lets the engine export the per-objective gauges (the pinned
+  exception: ``resilience/supervise.py``'s ``6.0`` teardown drain
+  margin).
 - **Steady-path gate** (always run, AST-based): inside
   ``dmlc_tpu/data/`` and ``dmlc_tpu/pipeline/``, per-row Python loops
   over block payloads (``for row in …`` or ``range(<x>.size)`` index
@@ -1053,6 +1060,73 @@ def trace_header_lint(paths: List[str],
     return findings
 
 
+# The slo.* metric family and the burn-rate alert thresholds belong to
+# dmlc_tpu/obs/slo.py — ONE home for the alert math. A hand-spelled
+# "slo.xxx" gauge elsewhere would fork the family the /slo surfaces
+# render, and a re-spelled 14.4/6.0 would fork the SRE-workbook
+# thresholds every consumer imports as FAST_BURN_RATE/SLOW_BURN_RATE.
+SLO_ALLOWED = {
+    "dmlc_tpu/obs/slo.py",
+}
+_BURN_RATE_LITERALS = {14.4, 6.0}
+# non-alert uses of the bare numbers, pinned: supervise.py's 6.0 is a
+# gang-teardown drain margin (deadline - 6.0), not burn-rate math
+BURN_RATE_EXEMPT = {
+    "dmlc_tpu/resilience/supervise.py",
+}
+
+
+def _slo_instrument_name(call: ast.Call) -> bool:
+    """True when an instrument call's literal (or f-string) name sits
+    in the slo.* family."""
+    if not call.args:
+        return False
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value.startswith("slo.")
+    if isinstance(a, ast.JoinedStr) and a.values:
+        first = a.values[0]
+        return (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value.startswith("slo."))
+    return False
+
+
+def slo_lint(paths: List[str],
+             trees: Optional[dict] = None) -> List[str]:
+    """The SLO gate: ``slo.*`` instrument names and the burn-rate
+    threshold floats confined to obs/slo.py (see above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if rel in SLO_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _INSTRUMENT_METHODS
+                    and _slo_instrument_name(node)):
+                findings.append(
+                    f"{rel}:{node.lineno}: slo.* instrument outside "
+                    "obs/slo.py — the slo.* metric family is owned by "
+                    "dmlc_tpu.obs.slo (the engine exports the "
+                    "per-objective gauges itself)")
+            elif (isinstance(node, ast.Constant)
+                    and isinstance(node.value, float)
+                    and node.value in _BURN_RATE_LITERALS
+                    and rel not in BURN_RATE_EXEMPT):
+                findings.append(
+                    f"{rel}:{node.lineno}: burn-rate threshold "
+                    f"{node.value!r} outside obs/slo.py — import "
+                    "FAST_BURN_RATE/SLOW_BURN_RATE from "
+                    "dmlc_tpu.obs.slo (one home for alert math)")
+    return findings
+
+
 def main() -> int:
     paths = python_files()
     findings = builtin_lint(paths)
@@ -1071,6 +1145,7 @@ def main() -> int:
     findings += socket_lint(paths, trees)
     findings += thread_lint(paths, trees)
     findings += trace_header_lint(paths, trees)
+    findings += slo_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

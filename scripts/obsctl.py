@@ -33,6 +33,12 @@ Subcommands (all read-only; the plane stays in charge):
                  server-reported handle time vs network+queue
                  residual — "is the wire slow or is the server slow"
                  answerable per edge from the CLI;
+- ``slo``      — a rank's ``/slo`` declared objectives (obs.slo):
+                 per-objective windowed attainment, error-budget
+                 remaining, and fast/slow burn-alert state — "are we
+                 keeping the promises we declared" answerable from
+                 the CLI; exit 2 with the server's enable hint when
+                 nothing is declared;
 - ``profile``  — a rank's ``/profile`` merged Python+native
                  flamegraph: live burst (``--seconds N --hz M``) or
                  the continuous trie, summarized as a top-frame
@@ -523,6 +529,64 @@ def cmd_rpc(args) -> int:
     return 0
 
 
+def render_slo(doc: Dict[str, Any]) -> str:
+    """One /slo payload -> per-objective judgment table: windowed
+    attainment, error budget remaining, and which burn alert (if any)
+    is firing right now."""
+    lines = [f"slo: fast-burn >= {doc.get('fast_burn_rate')}x · "
+             f"slow-burn >= {doc.get('slow_burn_rate')}x "
+             "(both windows of a pair must exceed the rate)"]
+    hdr = ["objective", "tenant", "metric", "target", "window",
+           "attain", "budget left", "burn", "fast burn", "alert"]
+    rows: List[List[str]] = []
+    for name, o in sorted((doc.get("objectives") or {}).items()):
+        w = o.get("windows") or {}
+        alerts = o.get("alerts") or {}
+        alert = ("FAST-BURN" if alerts.get("fast")
+                 else "slow-burn" if alerts.get("slow") else "-")
+        if o.get("incomplete"):
+            alert += " (incomplete)"
+        att = o.get("attainment")
+        rem = o.get("budget_remaining")
+        rows.append([
+            str(name), str(o.get("tenant") or "-"),
+            str(o.get("metric")),
+            f"{o.get('target_s')}s", f"{o.get('window_s')}s",
+            f"{att:.2%}" if att is not None else "-",
+            f"{rem:.0%}" if rem is not None else "-",
+            _fmt((w.get("long") or {}).get("burn"), 1),
+            _fmt((w.get("fast_short") or {}).get("burn"), 1),
+            alert,
+        ])
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows
+              else len(c) for i, c in enumerate(hdr)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(hdr, widths)))
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    lines.append("(burn = long-window burn rate; fast burn = the "
+                 "short fast window — the alert's reset edge)")
+    if doc.get("incomplete"):
+        lines.append(f"INCOMPLETE gang rollup: unreachable "
+                     f"{', '.join(doc.get('unreachable') or [])} — "
+                     "attainment judged on a subset of the gang")
+    return "\n".join(lines)
+
+
+def cmd_slo(args) -> int:
+    port = _default_port(args)
+    doc = _fetch(port, "/slo", host=args.host)
+    if "objectives" not in doc:
+        # the server's 404 payload ({error, hint}: nothing declared)
+        # — surface the hint, exit 2 like tenants/control
+        print(json.dumps(doc))
+        return 2
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    print(render_slo(doc))
+    return 0
+
+
 def cmd_profile(args) -> int:
     port = _default_port(args)
     qs = []
@@ -631,6 +695,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                             "latency attribution)")
     common(p)
     p.set_defaults(fn=cmd_rpc)
+
+    p = sub.add_parser("slo",
+                       help="a rank's /slo declared objectives "
+                            "(attainment, error budget, burn alerts)")
+    common(p)
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser("profile",
                        help="a rank's merged Python+native flamegraph")

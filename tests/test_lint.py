@@ -508,3 +508,35 @@ class TestLintGate:
     def test_trace_header_gate_allows_rpc_module(self):
         path = os.path.join(lint.REPO, "dmlc_tpu", "obs", "rpc.py")
         assert lint.trace_header_lint([path]) == []
+
+    def test_slo_gate_clean(self):
+        # slo.* instrument names and the 14.4/6.0 burn-rate floats
+        # live only in obs/slo.py; everyone else reads the engine
+        findings = lint.slo_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_slo_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe14.py")
+        with open(bad, "w") as f:
+            f.write("def f(reg, name):\n"
+                    "    reg.gauge('slo.x.attainment').set(1)\n"
+                    "    reg.counter(f'slo.{name}.hits').inc()\n"
+                    "    fast = 14.4\n"
+                    "    slow = 6.0\n"
+                    "    ok = reg.gauge('slow.x')\n"  # not slo.*
+                    "    s = 'slo.free.string'\n"     # not an
+                    "    return fast, slow, ok, s\n")  # instrument
+        try:
+            findings = lint.slo_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 4, "\n".join(findings)
+        assert all("obs/slo.py" in f for f in findings)
+
+    def test_slo_gate_allowlist_and_burn_exemption(self):
+        slo = os.path.join(lint.REPO, "dmlc_tpu", "obs", "slo.py")
+        assert lint.slo_lint([slo]) == []
+        # supervise.py's 6.0 is a drain margin, not a burn threshold
+        sup = os.path.join(lint.REPO, "dmlc_tpu", "resilience",
+                           "supervise.py")
+        assert lint.slo_lint([sup]) == []
