@@ -89,6 +89,14 @@ Configs (1-5 in BASELINE.json order; 6-7 added r3):
                slo-bound fast-burn verdict, then pause("bully")
                clears the alert; attainment / burn / time-to-fire /
                time-to-clear in the JSON
+ 23. global_shuffle — the gang-wide sample-level shuffle's acceptance
+               probe: a REAL 2-process gang drains one seeded global
+               permutation over a larger-than-window RecordIO corpus,
+               windows exchanged via the peer /pages tier; the merged
+               rank streams must be byte-identical to the world-1
+               order (same seed ⇒ same order at any world size),
+               sha256 set-identical to the unshuffled corpus, with a
+               visible peer-served fraction and a wire-free warm epoch
 
 Run: python -m dmlc_tpu.bench_suite [--config N] [--mb MB] [--device]
 
@@ -2451,6 +2459,119 @@ def bench_slo_burn(mb: int) -> Dict:
         sched_mod.uninstall()
 
 
+def bench_global_shuffle(mb: int) -> Dict:
+    """Config 23 (ROADMAP item 5): a REAL 2-process gang draining one
+    seeded global permutation over a larger-than-window RecordIO
+    corpus, each rank with its OWN page store, exchanging shuffle
+    windows through the peer ``/pages`` tier. Asserts the tentpole's
+    acceptance — the two ranks' ordered streams round-robin-merge
+    byte-identically into the world-1 in-process drain (same seed ⇒
+    same global order at any world size), the merged set is
+    sha256-identical to the unshuffled corpus (exact coverage), every
+    rank peer-fetches a visible fraction of its non-owned windows, and
+    the warm epoch replays wire- and peer-free from the local store."""
+    import hashlib
+    import sys
+    import tempfile
+
+    from dmlc_tpu.io.recordio import RecordIOChunkReader
+    from dmlc_tpu.parallel.launch import launch_local
+    from dmlc_tpu.shuffle import (
+        GlobalShuffle, GlobalShuffleSplit, build_record_index,
+        displacement_stats,
+    )
+
+    seed, window_bytes = 23, 2 << 20
+    paths = make_recordio(f"{_TMP}.shuffle", mb, nparts=2, seed=5)
+    uri = ";".join(paths)
+    size = sum(os.path.getsize(p) for p in paths)
+
+    # the unshuffled corpus record set (payload sha256s, file order)
+    corpus = []
+    for p in paths:
+        with open(p, "rb") as f:
+            for rec in RecordIOChunkReader(f.read()):
+                corpus.append(hashlib.sha256(rec).hexdigest())
+
+    # the world-1 golden: the full global order drained in-process
+    t0 = time.perf_counter()
+    sp = GlobalShuffleSplit(uri, 0, 1, "recordio", seed=seed,
+                            window_bytes=window_bytes)
+    golden = [hashlib.sha256(rec).hexdigest() for rec in sp]
+    solo_wall = time.perf_counter() - t0
+    n, windows = len(golden), sp.reader.num_windows
+    assert windows >= 8, \
+        f"corpus not larger-than-window ({windows} windows)"
+    assert sorted(golden) == sorted(corpus), \
+        "world-1 drain lost/duplicated records vs the corpus"
+    idx = build_record_index(uri, "recordio")
+    disp = displacement_stats(
+        GlobalShuffle(idx.sizes, seed, window_bytes).order(0))
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_shuffle_worker.py")
+    out_dir = tempfile.mkdtemp(prefix="dmlc_bench_shuffle_")
+    env = {"PYTHONPATH": os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [p for p in os.environ.get("PYTHONPATH",
+                                     "").split(os.pathsep) if p])}
+    try:
+        launch_local(2, [sys.executable, worker, uri, out_dir,
+                         str(seed), str(window_bytes)],
+                     env=env, serve_ports=True, timeout=600)
+        results = []
+        for rank in range(2):
+            with open(os.path.join(out_dir,
+                                   f"shuffle-{rank}.json")) as f:
+                results.append(json.load(f))
+    finally:
+        import shutil
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+    results.sort(key=lambda r: r["rank"])
+    streams = [r["cold"]["hashes"] for r in results]
+    merged = [streams[p % 2][p // 2] for p in range(n)]
+    assert merged == golden, \
+        "2-rank merge diverged from the world-1 order (seed broken)"
+    per_rank_wire = [r["cold"]["counters"]["shuffle.bytes.wire"]
+                     for r in results]
+    per_rank_peer = [r["cold"]["counters"]["shuffle.bytes.peer"]
+                     for r in results]
+    for r in results:
+        c = r["cold"]["counters"]
+        assert c["shuffle.bytes.peer"] > 0, \
+            f"rank {r['rank']} peer-fetched nothing (tier inert?)"
+        w = r["warm"]["counters"]
+        assert w["shuffle.bytes.wire"] == 0 and \
+            w["shuffle.bytes.peer"] == 0, \
+            (f"rank {r['rank']} warm epoch left the local store: "
+             f"{w}")
+        assert r["warm"]["n"] == r["cold"]["n"], \
+            f"rank {r['rank']} warm epoch coverage drifted"
+    total_wire = sum(per_rank_wire)
+    assert total_wire <= 1.6 * size, \
+        (f"gang wired {total_wire} bytes > 160% of the {size}-byte "
+         "corpus — the peer tier did not carry the exchange")
+    cold_wall = max(r["cold"]["wall_s"] for r in results)
+    warm_wall = max(r["warm"]["wall_s"] for r in results)
+    return {"config": "global_shuffle", "procs": 2, "bytes": size,
+            "records": n, "windows": windows,
+            "window_bytes": window_bytes,
+            "gbps": size / warm_wall / 1e9,  # steady local replay
+            "cold_gbps": round(size / cold_wall / 1e9, 4),
+            "solo_gbps": round(size / solo_wall / 1e9, 4),
+            "wire_bytes_per_rank": per_rank_wire,
+            "peer_bytes_per_rank": per_rank_peer,
+            "peer_frac_per_rank": [
+                round(p / (p + w), 4) if p + w else 0.0
+                for p, w in zip(per_rank_peer, per_rank_wire)],
+            "gang_wire_frac": round(total_wire / size, 4),
+            "displacement_normalized": round(
+                disp["normalized_mean"], 4),
+            "hash": hashlib.sha256(
+                "\n".join(sorted(golden)).encode()).hexdigest()}
+
+
 CONFIGS = {
     1: ("libsvm", lambda mb, dev: bench_libsvm(mb)),
     2: ("csv", lambda mb, dev: bench_csv(mb)),
@@ -2475,13 +2596,14 @@ CONFIGS = {
     21: ("ckpt_restore_fanout",
          lambda mb, dev: bench_ckpt_restore_fanout(mb)),
     22: ("slo_burn", lambda mb, dev: bench_slo_burn(mb)),
+    23: ("global_shuffle", lambda mb, dev: bench_global_shuffle(mb)),
 }
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=0,
-                    help="1-22 (0 = all)")
+                    help="1-23 (0 = all)")
     ap.add_argument("--mb", type=int, default=64,
                     help="approx data size per config in MB")
     ap.add_argument("--device", action="store_true",

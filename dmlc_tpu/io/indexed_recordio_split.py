@@ -82,8 +82,9 @@ class IndexedRecordIOSplit(InputSplit):
     def before_first(self) -> None:
         order = np.arange(len(self._mine))
         if self._shuffle:
+            from dmlc_tpu.shuffle.permutation import epoch_rng
             nbatch = (len(order) + self._batch_size - 1) // self._batch_size
-            rng = np.random.RandomState(self._seed + self._epoch)
+            rng = epoch_rng(self._seed, self._epoch)
             batches = [order[b * self._batch_size:(b + 1) * self._batch_size]
                        for b in rng.permutation(nbatch)]
             order = np.concatenate(batches) if batches else order

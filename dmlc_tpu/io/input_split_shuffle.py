@@ -12,8 +12,6 @@ from __future__ import annotations
 
 from typing import Iterator, List, Optional
 
-import numpy as np
-
 from dmlc_tpu.io.input_split import InputSplit
 from dmlc_tpu.utils.logging import check
 
@@ -51,7 +49,8 @@ class InputSplitShuffle(InputSplit):
                                  num_shuffle_parts, seed, **kwargs)
 
     def before_first(self) -> None:
-        rng = np.random.RandomState(self._seed + self._epoch)
+        from dmlc_tpu.shuffle.permutation import epoch_rng
+        rng = epoch_rng(self._seed, self._epoch)
         self._order = rng.permutation(len(self._subs))
         self._epoch += 1
         self._cursor = 0

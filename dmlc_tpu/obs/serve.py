@@ -536,6 +536,19 @@ class _Handler(BaseHTTPRequestHandler):
                         code=404)
                 else:
                     self._send_json(eng.view())
+            elif url.path == "/shuffle":
+                from dmlc_tpu import shuffle as _shuffle
+                doc = _shuffle.view()
+                if doc is None:
+                    self._send_json(
+                        {"error": "no global shuffle active",
+                         "hint": "Pipeline.from_uri(...).shuffle("
+                                 "global_seed=...) or construct "
+                                 "dmlc_tpu.shuffle.GlobalShuffleSplit "
+                                 "in this process"},
+                        code=404)
+                else:
+                    self._send_json(doc)
             elif url.path == "/analyze":
                 verdict = owner.analyze_verdict()
                 # a burning declared objective rides along: the stage
@@ -597,6 +610,7 @@ class _Handler(BaseHTTPRequestHandler):
                                                "/trace?seconds=N",
                                                "/history", "/gang",
                                                "/tenants", "/slo",
+                                               "/shuffle",
                                                "/analyze",
                                                "/control[?last=N]",
                                                "/profile?seconds=N"

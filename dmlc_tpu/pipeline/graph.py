@@ -204,20 +204,35 @@ class _ParseRunner(_RunnerBase):
             self._stream_split = split
             kwargs["split_factory"] = lambda: split
         if shuffle is not None:
-            # chunk-level shuffled read order lowers to InputSplitShuffle
-            # injected under the python engine (the native reader owns
-            # its own split)
-            from dmlc_tpu.io.input_split_shuffle import InputSplitShuffle
+            # shuffled read order lowers to an injected split under the
+            # python engine (the native reader owns its own split):
+            # global_seed → the sample-level GlobalShuffleSplit, else
+            # the chunk-level InputSplitShuffle
             kwargs["engine"] = "python"
             chunk = kwargs.get("chunk_size", 8 << 20)
             shp = shuffle.params
 
-            def factory():
-                return InputSplitShuffle.create(
-                    sp["uri"], sp["part_index"], sp["num_parts"],
-                    sp["split_type"],
-                    num_shuffle_parts=shp["num_shuffle_parts"],
-                    seed=shp["seed"], chunk_size=chunk)
+            if shp.get("global_seed") is not None:
+                from dmlc_tpu.shuffle.split import GlobalShuffleSplit
+
+                def factory():
+                    from dmlc_tpu.shuffle.exchange import \
+                        DEFAULT_WINDOW_BYTES
+                    wb = shp.get("window_bytes") or DEFAULT_WINDOW_BYTES
+                    return GlobalShuffleSplit(
+                        sp["uri"], sp["part_index"], sp["num_parts"],
+                        sp["split_type"], seed=shp["global_seed"],
+                        window_bytes=wb)
+            else:
+                from dmlc_tpu.io.input_split_shuffle import \
+                    InputSplitShuffle
+
+                def factory():
+                    return InputSplitShuffle.create(
+                        sp["uri"], sp["part_index"], sp["num_parts"],
+                        sp["split_type"],
+                        num_shuffle_parts=shp["num_shuffle_parts"],
+                        seed=shp["seed"], chunk_size=chunk)
 
             kwargs["split_factory"] = factory
         if sp["split_type"] != "text":
@@ -247,9 +262,12 @@ class _ParseRunner(_RunnerBase):
             # param struct swallows unknown keys) would silently yield
             # UNshuffled data — refuse instead
             from dmlc_tpu.io.input_split_shuffle import InputSplitShuffle
+            from dmlc_tpu.shuffle.split import GlobalShuffleSplit
             split = getattr(self._parser, "_split", None)
-            if (shuffle.params["num_shuffle_parts"] > 1
-                    and not isinstance(split, InputSplitShuffle)):
+            wants = (shuffle.params.get("global_seed") is not None
+                     or shuffle.params["num_shuffle_parts"] > 1)
+            if (wants and not isinstance(
+                    split, (InputSplitShuffle, GlobalShuffleSplit))):
                 raise DMLCError(
                     f"pipeline: shuffle is not supported by the "
                     f"{fmt or 'default'} parser (it ignores the "
@@ -1181,15 +1199,30 @@ class Pipeline:
                                     prefetch_depth=prefetch_depth,
                                     **kwargs))
 
-    def shuffle(self, num_shuffle_parts: int = 4,
-                seed: int = 0) -> "Pipeline":
-        """Chunk-level shuffled read order (InputSplitShuffle): the
-        shard subdivides into num_shuffle_parts sub-shards whose order
-        reshuffles each epoch, deterministically from the seed."""
+    def shuffle(self, num_shuffle_parts: int = 4, seed: int = 0,
+                global_seed: Optional[int] = None,
+                window_bytes: Optional[int] = None) -> "Pipeline":
+        """Shuffled read order. Default: chunk-level
+        (InputSplitShuffle) — the shard subdivides into
+        num_shuffle_parts sub-shards whose order reshuffles each
+        epoch, deterministically from ``seed``.
+
+        ``global_seed`` switches to the gang-wide SAMPLE-level shuffle
+        (dmlc_tpu.shuffle.GlobalShuffleSplit): a seeded global
+        permutation over every record of the dataset, identical at any
+        world size, window-bounded to ``window_bytes`` resident bytes
+        (default dmlc_tpu.shuffle.DEFAULT_WINDOW_BYTES), with window
+        pages exchanged through the peer /pages tier."""
         check(num_shuffle_parts >= 1, "num_shuffle_parts must be >= 1")
+        check(window_bytes is None or window_bytes > 0,
+              "shuffle: window_bytes must be > 0")
+        check(window_bytes is None or global_seed is not None,
+              "shuffle: window_bytes applies to the global shuffle — "
+              "pass global_seed")
         return self._with(StageSpec("shuffle",
                                     num_shuffle_parts=num_shuffle_parts,
-                                    seed=seed))
+                                    seed=seed, global_seed=global_seed,
+                                    window_bytes=window_bytes))
 
     def cache(self, path: Optional[str] = None,
               rows_per_page: int = 64 << 10,

@@ -11,8 +11,11 @@ ShardedRowBlockIter — rather than reimplementing it (see
 Stage catalog (docs/pipeline.md has the narrative version):
 
   source    — from_uri(uri, part_index, num_parts): the sharded byte span
-  shuffle   — chunk-level shuffled read order (InputSplitShuffle;
-              python engine, reference: input_split_shuffle.h)
+  shuffle   — shuffled read order, python engine. Default: chunk-level
+              (InputSplitShuffle, reference: input_split_shuffle.h);
+              with global_seed: gang-wide sample-level global
+              permutation (dmlc_tpu.shuffle.GlobalShuffleSplit),
+              window-bounded, exchanged via the peer /pages tier
   parse     — text/columnar bytes → CSR RowBlock stream (Parser.create)
   cache     — parse once, replay later epochs; the tier is picked by
               memory_budget_bytes: raw blocks in RAM when they fit,

@@ -67,6 +67,12 @@ without the tools baked in:
   lets the engine export the per-objective gauges (the pinned
   exception: ``resilience/supervise.py``'s ``6.0`` teardown drain
   margin).
+- **Random gate** (always run, AST-based): ``random`` /
+  ``numpy.random`` construction inside ``dmlc_tpu/io/`` and
+  ``dmlc_tpu/data/`` is forbidden — seeded-permutation ownership has
+  one home (``dmlc_tpu/shuffle/``): epoch randomness is drawn from
+  ``dmlc_tpu.shuffle.permutation.epoch_rng`` so the determinism
+  contract (same seed ⇒ same order, restart-stable resume) holds.
 - **Steady-path gate** (always run, AST-based): inside
   ``dmlc_tpu/data/`` and ``dmlc_tpu/pipeline/``, per-row Python loops
   over block payloads (``for row in …`` or ``range(<x>.size)`` index
@@ -1127,6 +1133,60 @@ def slo_lint(paths: List[str],
     return findings
 
 
+# Seeded permutations are a SEAM: dmlc_tpu/shuffle/ (epoch_rng /
+# GlobalShuffle) is the ONE home for RNG construction in the data
+# path — ad-hoc `random` / `numpy.random` use inside dmlc_tpu/io/ or
+# dmlc_tpu/data/ would mint a shuffle order the determinism contract
+# (same seed ⇒ same global order at any world size, restart-stable
+# resume) never sees. The list shrinks, it does not grow.
+RANDOM_ALLOWED: set = set()
+_RANDOM_DIRS = ("dmlc_tpu/io/", "dmlc_tpu/data/")
+
+
+def random_lint(paths: List[str],
+                trees: Optional[dict] = None) -> List[str]:
+    """The random gate: ``random``/``numpy.random`` construction in
+    dmlc_tpu/io/ + dmlc_tpu/data/ confined to dmlc_tpu/shuffle/
+    (see above)."""
+    if trees is None:
+        trees = _parse_package_trees(paths)
+    findings: List[str] = []
+    for path in paths:
+        if path not in trees:
+            continue
+        rel, tree = trees[path]
+        if not rel.startswith(_RANDOM_DIRS) or rel in RANDOM_ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            hits = []
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    root = a.name.split(".")[0]
+                    if root == "random" or a.name.startswith(
+                            "numpy.random"):
+                        hits.append(a.name)
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                mod = node.module or ""
+                if mod == "random" or mod.startswith("numpy.random"):
+                    hits.append(mod)
+                elif mod == "numpy":
+                    hits.extend(f"numpy.{a.name}" for a in node.names
+                                if a.name == "random")
+            elif (isinstance(node, ast.Attribute)
+                    and node.attr == "random"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in ("np", "numpy")):
+                hits.append(f"{node.value.id}.random")
+            for hit in hits:
+                findings.append(
+                    f"{rel}:{node.lineno}: {hit} in the data path — "
+                    "seeded permutations have one home: draw epoch "
+                    "randomness from dmlc_tpu.shuffle.permutation."
+                    "epoch_rng (or lower onto GlobalShuffle) so the "
+                    "determinism contract holds")
+    return findings
+
+
 def main() -> int:
     paths = python_files()
     findings = builtin_lint(paths)
@@ -1146,6 +1206,7 @@ def main() -> int:
     findings += thread_lint(paths, trees)
     findings += trace_header_lint(paths, trees)
     findings += slo_lint(paths, trees)
+    findings += random_lint(paths, trees)
     ruff = run_ruff()
     if ruff is None:
         print("lint: ruff not installed — built-in checks only",

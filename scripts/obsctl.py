@@ -39,6 +39,13 @@ Subcommands (all read-only; the plane stays in charge):
                  keeping the promises we declared" answerable from
                  the CLI; exit 2 with the server's enable hint when
                  nothing is declared;
+- ``shuffle``  — a rank's ``/shuffle`` global-shuffle row
+                 (dmlc_tpu.shuffle): permutation identity (seed,
+                 epoch, window budget), coverage watermark, and the
+                 local/peer/wire split of exchanged records and
+                 bytes — "is the gang actually exchanging through
+                 the peer tier" answerable from the CLI; exit 2 with
+                 the server's enable hint when no shuffle is active;
 - ``profile``  — a rank's ``/profile`` merged Python+native
                  flamegraph: live burst (``--seconds N --hz M``) or
                  the continuous trie, summarized as a top-frame
@@ -587,6 +594,61 @@ def cmd_slo(args) -> int:
     return 0
 
 
+def _fmt_bytes(n: int) -> str:
+    """1536 -> '1.5KiB' — compact byte counts for the row tables."""
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if v < 1024 or unit == "TiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024
+    return f"{int(n)}B"
+
+
+def render_shuffle(doc: Dict[str, Any]) -> str:
+    """One /shuffle payload -> the rank's global-shuffle row: the
+    permutation identity (seed/epoch/window budget), the coverage
+    watermark, and where the exchanged bytes actually came from
+    (local page store vs peer /pages tier vs source wire)."""
+    rec = doc.get("records_by_tier") or {}
+    byt = doc.get("bytes_by_tier") or {}
+    cov = doc.get("coverage")
+    lines = [
+        f"shuffle: seed {doc.get('seed')} · epoch {doc.get('epoch')} "
+        f"· rank {doc.get('rank')}/{doc.get('world')} · "
+        f"{doc.get('uri')} ({doc.get('split_type')})",
+        f"  records {doc.get('records')} in {doc.get('windows')} "
+        f"windows (budget {_fmt_bytes(doc.get('window_bytes') or 0)})",
+        f"  position {doc.get('position')} · delivered "
+        f"{doc.get('delivered')} · coverage "
+        + (f"{cov:.2%}" if cov is not None else "-"),
+    ]
+    hdr = ["tier", "records", "bytes"]
+    rows = [[t, str(rec.get(t, 0)), _fmt_bytes(byt.get(t, 0))]
+            for t in ("local", "peer", "wire")]
+    widths = [max(len(c), *(len(r[i]) for r in rows))
+              for i, c in enumerate(hdr)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(hdr, widths)))
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    lines.append("(peer = window pages served by another rank's "
+                 "/pages tier; wire = hydrated from the source)")
+    return "\n".join(lines)
+
+
+def cmd_shuffle(args) -> int:
+    port = _default_port(args)
+    doc = _fetch(port, "/shuffle", host=args.host)
+    if "records_by_tier" not in doc:
+        # the server's 404 payload ({error, hint}: no shuffle active)
+        print(json.dumps(doc))
+        return 2
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    print(render_shuffle(doc))
+    return 0
+
+
 def cmd_profile(args) -> int:
     port = _default_port(args)
     qs = []
@@ -701,6 +763,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                             "(attainment, error budget, burn alerts)")
     common(p)
     p.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser("shuffle",
+                       help="a rank's /shuffle global-shuffle row "
+                            "(seed, epoch, window budget, coverage, "
+                            "local/peer/wire exchange)")
+    common(p)
+    p.set_defaults(fn=cmd_shuffle)
 
     p = sub.add_parser("profile",
                        help="a rank's merged Python+native flamegraph")

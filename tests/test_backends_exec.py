@@ -159,3 +159,102 @@ class TestKubernetesManifest:
     def test_manifest_rejects_bad_world(self):
         with pytest.raises(Exception):
             kubernetes_manifest(0, ["x"], COORD, image="img")
+
+
+# worker: append the rendezvous env contract to the shared results file
+RNDV_WORKER = [sys.executable, "-c",
+               "import os;"
+               "f=open(os.environ['RESULTS'],'a');"
+               "f.write(' '.join([os.environ['DMLC_TPU_RNDV_URI'],"
+               "os.environ['DMLC_TPU_RNDV_PORT'],"
+               "os.environ['DMLC_TPU_RNDV_GANG']])+'\\n');"
+               "f.close()"]
+
+RNDV = ("rndv.example", 9901)
+
+
+class TestRendezvousEnvExport:
+    """ROADMAP item 1's named leftover: every scheduler backend must
+    export DMLC_TPU_RNDV_URI/PORT/GANG so scheduler-launched gangs
+    reach the same elastic membership service that launch_local and
+    launch_ssh gangs do — validated by execution per backend."""
+
+    def test_mpi_exports_rendezvous_env(self, tmp_path):
+        line = mpi_command(2, RNDV_WORKER, COORD,
+                           rendezvous_addr=RNDV, rendezvous_gang="g1")
+        bindir = tmp_path / "bin"
+        bindir.mkdir()
+        _write_stub(str(bindir), "mpirun", r"""
+n=1; declare -a exports
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -n) n="$2"; shift 2;;
+    -x) exports+=("$2"); shift 2;;
+    --hostfile) shift 2;;
+    *) break;;
+  esac
+done
+for ((r=0; r<n; r++)); do
+  env "${exports[@]}" OMPI_COMM_WORLD_RANK=$r "$@" || exit 1
+done
+""")
+        results = tmp_path / "out.txt"
+        run = subprocess.run(
+            line, shell=True,
+            env={**os.environ, "PATH": f"{bindir}:{os.environ['PATH']}",
+                 "RESULTS": str(results)},
+            capture_output=True, text=True, timeout=120)
+        assert run.returncode == 0, run.stderr
+        assert _results(results) == [["rndv.example", "9901", "g1"]] * 2
+
+    def test_sge_exports_rendezvous_env(self, tmp_path):
+        script = sge_script(2, RNDV_WORKER, COORD,
+                            rendezvous_addr=RNDV, rendezvous_gang="g1")
+        syn = subprocess.run(["bash", "-n"], input=script, text=True,
+                             capture_output=True)
+        assert syn.returncode == 0, syn.stderr
+        results = tmp_path / "out.txt"
+        sh = tmp_path / "job.sh"
+        sh.write_text(script)
+        for task in (1, 2):
+            run = subprocess.run(
+                ["bash", str(sh)],
+                env={**os.environ, "SGE_TASK_ID": str(task),
+                     "RESULTS": str(results)},
+                capture_output=True, text=True, timeout=120)
+            assert run.returncode == 0, run.stderr
+        assert _results(results) == [["rndv.example", "9901", "g1"]] * 2
+
+    def test_kubernetes_exports_rendezvous_env(self):
+        m = kubernetes_manifest(3, ["python", "train.py"], COORD,
+                                image="gcr.io/x/worker:1",
+                                rendezvous_addr=RNDV,
+                                rendezvous_gang="g1")
+        (container,) = m["spec"]["template"]["spec"]["containers"]
+        by_name = {e["name"]: e for e in container["env"]}
+        assert by_name["DMLC_TPU_RNDV_URI"]["value"] == "rndv.example"
+        assert by_name["DMLC_TPU_RNDV_PORT"]["value"] == "9901"
+        assert by_name["DMLC_TPU_RNDV_GANG"]["value"] == "g1"
+        json.dumps(m)
+
+    def test_backends_default_to_submit_host_env(self, monkeypatch):
+        # no explicit addr: the submit host's own rendezvous env is
+        # forwarded (gang defaults "local"); without either, nothing
+        # is exported
+        monkeypatch.setenv("DMLC_TPU_RNDV_URI", "fwd.example")
+        monkeypatch.setenv("DMLC_TPU_RNDV_PORT", "9333")
+        monkeypatch.delenv("DMLC_TPU_RNDV_GANG", raising=False)
+        line = mpi_command(2, ["w"], COORD)
+        assert "-x DMLC_TPU_RNDV_URI=fwd.example" in line
+        assert "-x DMLC_TPU_RNDV_PORT=9333" in line
+        assert "-x DMLC_TPU_RNDV_GANG=local" in line
+        script = sge_script(2, ["w"], COORD)
+        assert "export DMLC_TPU_RNDV_URI=fwd.example" in script
+        m = kubernetes_manifest(2, ["w"], COORD, image="img")
+        names = [e["name"] for e in
+                 m["spec"]["template"]["spec"]["containers"][0]["env"]]
+        assert "DMLC_TPU_RNDV_URI" in names
+        monkeypatch.delenv("DMLC_TPU_RNDV_URI")
+        monkeypatch.delenv("DMLC_TPU_RNDV_PORT")
+        line = mpi_command(2, ["w"], COORD)
+        assert "RNDV" not in line

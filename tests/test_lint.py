@@ -540,3 +540,44 @@ class TestLintGate:
         sup = os.path.join(lint.REPO, "dmlc_tpu", "resilience",
                            "supervise.py")
         assert lint.slo_lint([sup]) == []
+
+    def test_random_gate_clean(self):
+        # random/numpy.random construction in dmlc_tpu/io/ +
+        # dmlc_tpu/data/ confined to dmlc_tpu/shuffle/ (epoch_rng)
+        findings = lint.random_lint(lint.python_files())
+        assert findings == [], "\n".join(findings)
+
+    def test_random_gate_catches_planted_violations(self):
+        bad = os.path.join(lint.REPO, "dmlc_tpu", "io",
+                           "_lintprobe15.py")
+        with open(bad, "w") as f:
+            f.write("import random\n"
+                    "import numpy.random\n"
+                    "from random import shuffle\n"
+                    "from numpy.random import RandomState\n"
+                    "from numpy import random\n"
+                    "import numpy as np\n"
+                    "r = np.random.RandomState(0)\n"
+                    "from dmlc_tpu.shuffle.permutation "
+                    "import epoch_rng\n")  # fine: the one home
+        try:
+            findings = lint.random_lint([bad])
+        finally:
+            os.remove(bad)
+        assert len(findings) == 6, "\n".join(findings)
+        assert all("epoch_rng" in f for f in findings)
+
+    def test_random_gate_scope(self):
+        # outside io/ + data/ the gate does not apply (shuffle/ owns
+        # the permutation; bench/test helpers keep their own rngs)
+        out = os.path.join(lint.REPO, "dmlc_tpu", "_lintprobe16.py")
+        with open(out, "w") as f:
+            f.write("import random\nimport numpy.random\n")
+        try:
+            assert lint.random_lint([out]) == []
+        finally:
+            os.remove(out)
+        # the permutation module itself draws numpy randomness freely
+        perm = os.path.join(lint.REPO, "dmlc_tpu", "shuffle",
+                            "permutation.py")
+        assert lint.random_lint([perm]) == []
